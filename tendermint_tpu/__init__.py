@@ -1,0 +1,10 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A from-scratch re-design of the capabilities of Tendermint Core v0.34.x
+(reference: /root/reference) built JAX/XLA-first: the signature-verification
+hot path (votes, commits, light-client headers) runs as a batched,
+shardable kernel on TPU, behind the same pluggable crypto seam the
+reference exposes (reference crypto/crypto.go:22-28).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,80 @@
+"""BatchVerifier — the batched verification seam the reference lacks.
+
+The reference verifies one signature at a time (crypto/crypto.go:22-28 has
+only PubKey.VerifySignature; SURVEY.md north star). Here, callers collect
+(pubkey, msg, sig) tuples and verify them in one device call:
+
+    bv = BatchVerifier()
+    bv.add(pub, msg, sig)          # any number of times
+    ok_all, per_item = bv.verify() # one TPU kernel launch
+
+Backends:
+* "jax"  — the batched TPU/CPU-XLA kernel (ed25519_jax.batch_verify);
+* "host" — scalar loop over PubKey.verify_signature (OpenSSL or pure-Python).
+
+Decisions are byte-identical across backends (enforced by differential
+tests). Default backend: "jax" when a device batch is worthwhile, "host" for
+tiny batches where kernel-launch latency would dominate — the threshold is
+overridable for benchmarking. Set env TMTPU_BATCH_BACKEND to pin one.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import Ed25519PubKey, PubKey
+
+# below this many signatures the host scalar loop beats a device round-trip
+DEFAULT_DEVICE_THRESHOLD = 16
+
+
+class BatchVerifier:
+    def __init__(self, backend: Optional[str] = None,
+                 device_threshold: int = DEFAULT_DEVICE_THRESHOLD):
+        self._backend = backend or os.environ.get("TMTPU_BATCH_BACKEND") or "auto"
+        if self._backend not in ("auto", "jax", "host"):
+            raise ValueError(f"unknown batch backend {self._backend!r}")
+        self._threshold = device_threshold
+        self._pks: List[bytes] = []
+        self._msgs: List[bytes] = []
+        self._sigs: List[bytes] = []
+        self._non_ed25519: List[Tuple[int, PubKey]] = []
+
+    def __len__(self) -> int:
+        return len(self._pks)
+
+    def add(self, pub: PubKey, msg: bytes, sig: bytes) -> None:
+        if not isinstance(pub, Ed25519PubKey):
+            # rare key types verify on host; remember position for the verdict
+            self._non_ed25519.append((len(self._pks), pub))
+        self._pks.append(pub.bytes())
+        self._msgs.append(msg)
+        self._sigs.append(sig)
+
+    def verify(self) -> Tuple[bool, np.ndarray]:
+        """-> (all_valid, per-item bool array). Resets the collected batch."""
+        pks, msgs, sigs = self._pks, self._msgs, self._sigs
+        non_ed = self._non_ed25519
+        self._pks, self._msgs, self._sigs, self._non_ed25519 = [], [], [], []
+        n = len(pks)
+        if n == 0:
+            return True, np.zeros(0, dtype=bool)
+
+        backend = self._backend
+        if backend == "auto":
+            backend = "jax" if n >= self._threshold else "host"
+
+        if backend == "jax" and not non_ed:
+            from .ed25519_jax import batch_verify
+
+            out = batch_verify(pks, msgs, sigs)
+        else:
+            out = np.zeros(n, dtype=bool)
+            non_ed_idx = {i: pk for i, pk in non_ed}
+            for i in range(n):
+                pub = non_ed_idx.get(i) or Ed25519PubKey(pks[i])
+                out[i] = pub.verify_signature(msgs[i], sigs[i])
+        return bool(out.all()), out

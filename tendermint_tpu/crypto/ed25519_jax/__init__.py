@@ -1,0 +1,7 @@
+"""Batched TPU Ed25519 verification (JAX): the framework's north-star kernel.
+
+See field.py (GF(2^255-19) limb arithmetic), curve.py (batched group ops),
+verify.py (host prep + jitted verification kernel).
+"""
+
+from .verify import batch_verify, prepare_batch, pack_device_inputs  # noqa: F401

@@ -1,0 +1,234 @@
+"""GF(2^255-19) arithmetic for TPU, in radix-2^15 with 17 uint32 limbs.
+
+Design notes (why this representation):
+
+* TPU int32 multiply returns the low 32 bits only — no widening multiply and
+  no fast int64. So limb products must fit in 32 bits *exactly*: with 15-bit
+  limbs (plus redundancy up to 2^15+2 after carries), products are < 2^31.
+* 17 limbs x 15 bits = 255 bits exactly, so the modular fold is aligned:
+  2^255 ≡ 19 (mod p) means column j+17 of a product folds into column j with
+  a single multiply by 19 — no sub-limb shifting.
+* Every field element is shaped ``(17, N)`` (limb index leading, batch in the
+  trailing dim) so the batch rides the 128-wide VPU lanes and limb-indexed
+  slicing is cheap.
+
+Invariant: limbs entering :func:`mul` are ``<= 2^15 + 2`` (guaranteed by
+:func:`carry`). All ops are jit/vmap-free pure jnp and shape-polymorphic in N.
+
+This replaces the scalar big-int arithmetic inside Go's x/crypto ed25519
+(reference crypto/ed25519/ed25519.go:148-155 → filippo.io/edwards25519 field)
+with a batched formulation; semantics are tested differentially against
+tendermint_tpu.crypto.ed25519.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 17
+RADIX = 15
+MASK = (1 << RADIX) - 1  # 0x7FFF
+
+P_INT = 2**255 - 19
+
+# p in limb form: limb0 = 2^15-19, limbs 1..16 = 2^15-1
+P_LIMBS = np.array([MASK - 18] + [MASK] * 16, dtype=np.uint32)
+# 2p in per-limb form with headroom for lazy subtraction: a + TWO_P - b >= 0
+# whenever b is carry-normalized (limbs <= 2^15+2 < 2^16-2).
+TWO_P_LIMBS = (P_LIMBS * 2).astype(np.uint32)
+
+
+# --- host-side packing helpers (numpy) ------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    out = np.zeros(NLIMBS, dtype=np.uint32)
+    for i in range(NLIMBS):
+        out[i] = (x >> (RADIX * i)) & MASK
+    return out
+
+
+def limbs_to_int(a) -> int:
+    a = np.asarray(a, dtype=np.uint64)
+    return sum(int(a[i]) << (RADIX * i) for i in range(len(a)))
+
+
+def bytes_to_limbs(b: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 little-endian -> (17, N) uint32 limbs of the low 255 bits.
+
+    The caller strips/keeps bit 255 (the x-sign bit) beforehand.
+    """
+    b = np.asarray(b, dtype=np.uint8)
+    n = b.shape[0]
+    padded = np.zeros((n, 34), dtype=np.uint32)
+    padded[:, :32] = b
+    out = np.zeros((NLIMBS, n), dtype=np.uint32)
+    for i in range(NLIMBS):
+        o = RADIX * i
+        byte, shift = o // 8, o % 8
+        word = padded[:, byte] | (padded[:, byte + 1] << 8) | (padded[:, byte + 2] << 16)
+        out[i] = (word >> shift) & MASK
+    out[16] &= (1 << 15) - 1
+    return out
+
+
+def limbs_to_bytes(a: np.ndarray) -> np.ndarray:
+    """(17, N) canonical limbs -> (N, 32) uint8 little-endian."""
+    a = np.asarray(a, dtype=np.uint64)
+    n = a.shape[1]
+    vals = np.zeros((n, 32), dtype=np.uint8)
+    acc = np.zeros(n, dtype=object)
+    for i in range(NLIMBS - 1, -1, -1):
+        acc = (acc << RADIX) | a[i]
+    for j in range(32):
+        vals[:, j] = (acc & 0xFF).astype(np.uint8)
+        acc >>= 8
+    return vals
+
+
+# --- device constants ------------------------------------------------------
+
+def const(x: int) -> jnp.ndarray:
+    """A field constant as a (17, 1) device array (broadcasts over batch)."""
+    return jnp.asarray(int_to_limbs(x % P_INT).reshape(NLIMBS, 1))
+
+
+# --- core ops --------------------------------------------------------------
+
+def carry(c: jnp.ndarray) -> jnp.ndarray:
+    """Carry-propagate column sums (< 2^26 per limb) to limbs <= 2^15+2.
+
+    One full sequential pass, fold the >=2^255 overflow back via x19, then one
+    extra step limb0->limb1. Post-condition: limb0 < 2^15, limb1 <= 2^15+2,
+    limbs 2..16 < 2^15 — all safe as mul inputs.
+    """
+    c = list(jnp.split(c.astype(jnp.uint32), NLIMBS, axis=0))
+    for i in range(NLIMBS - 1):
+        c[i + 1] = c[i + 1] + (c[i] >> RADIX)
+        c[i] = c[i] & MASK
+    top = c[16] >> RADIX
+    c[16] = c[16] & MASK
+    c[0] = c[0] + 19 * top
+    c[1] = c[1] + (c[0] >> RADIX)
+    c[0] = c[0] & MASK
+    return jnp.concatenate(c, axis=0)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    two_p = jnp.asarray(TWO_P_LIMBS.reshape(NLIMBS, 1))
+    return carry(a + two_p - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    two_p = jnp.asarray(TWO_P_LIMBS.reshape(NLIMBS, 1))
+    return carry(two_p - a + jnp.zeros_like(a))
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. Inputs carry-normalized (limbs <= 2^15+2)."""
+    # outer products, split into 15-bit halves so column sums stay < 2^26
+    prod = a[:, None, :] * b[None, :, :]          # (17, 17, N), each < 2^31
+    lo = prod & MASK
+    hi = prod >> RADIX
+    batch_shape = prod.shape[2:]
+    cols = jnp.zeros((2 * NLIMBS, ) + batch_shape, dtype=jnp.uint32)
+    for i in range(NLIMBS):
+        cols = cols.at[i:i + NLIMBS].add(lo[i])
+        cols = cols.at[i + 1:i + 1 + NLIMBS].add(hi[i])
+    # fold columns 17..33 back with x19 (2^255 ≡ 19): c_j += 19*c_{j+17}
+    folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
+    return carry(folded)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant (k < 2^15)."""
+    prod = a * jnp.uint32(k)
+    lo = prod & MASK
+    hi = prod >> RADIX
+    cols = jnp.zeros((NLIMBS + 1,) + a.shape[1:], dtype=jnp.uint32).at[:NLIMBS].add(lo)
+    cols = cols.at[1:NLIMBS + 1].add(hi)
+    folded = cols[:NLIMBS].at[0].add(19 * cols[NLIMBS])
+    return carry(folded)
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Reduce to the canonical representative in [0, p); limbs strictly 15-bit."""
+    # Repeated passes settle redundancy: after pass 2 the value is
+    # < 2^255 + 2^241; pass 3 folds any remaining >=2^255 excess; pass 4 runs
+    # with no fold and leaves every limb strictly 15-bit. (Each pass is 18
+    # cheap vector ops; freeze runs only ~4x per verification.)
+    a = carry(a)
+    a = carry(a)
+    a = carry(a)
+    a = carry(a)
+    # now value < 2^255, limbs < 2^15 strictly; conditionally subtract p once
+    p = jnp.asarray(P_LIMBS.reshape(NLIMBS, 1))
+    d = list(jnp.split(a.astype(jnp.int32) - p.astype(jnp.int32), NLIMBS, axis=0))
+    for i in range(NLIMBS - 1):
+        borrow = (d[i] >> 31) & 1          # 1 if negative
+        d[i] = d[i] + (borrow << RADIX)
+        d[i + 1] = d[i + 1] - borrow
+    final_borrow = (d[16] >> 31) & 1
+    d[16] = d[16] + (final_borrow << RADIX)
+    diff = jnp.concatenate(d, axis=0)
+    ge_p = (final_borrow == 0)             # a >= p
+    return jnp.where(ge_p, diff.astype(jnp.uint32), a)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """(N,) bool: a ≡ 0 (mod p)."""
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(N,) bool: a ≡ b (mod p)."""
+    return jnp.all(freeze(a) == freeze(b), axis=0)
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """(N,) uint32: low bit of the canonical representative."""
+    return freeze(a)[0] & 1
+
+
+# --- exponentiation chains -------------------------------------------------
+
+def _sqr_n(a: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jax.lax.fori_loop(0, n, lambda _, x: sqr(x), a)
+
+
+def _pow_2250_minus_1(z: jnp.ndarray):
+    """z^(2^250 - 1) plus intermediates needed by callers (ref10 chain)."""
+    z2 = sqr(z)                            # 2
+    z9 = mul(_sqr_n(z2, 2), z)             # 9
+    z11 = mul(z9, z2)                      # 11
+    z_5_0 = mul(sqr(z11), z9)              # 2^5 - 1
+    z_10_0 = mul(_sqr_n(z_5_0, 5), z_5_0)  # 2^10 - 1
+    z_20_0 = mul(_sqr_n(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_sqr_n(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_sqr_n(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_sqr_n(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_sqr_n(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_sqr_n(z_200_0, 50), z_50_0)
+    return z_250_0, z11
+
+
+def inverse(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) = z^(2^255 - 21); returns 0 for z = 0."""
+    z_250_0, z11 = _pow_2250_minus_1(z)
+    return mul(_sqr_n(z_250_0, 5), z11)
+
+
+def pow_p58(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3)."""
+    z_250_0, _ = _pow_2250_minus_1(z)
+    return mul(_sqr_n(z_250_0, 2), z)

@@ -1,0 +1,135 @@
+"""RFC 6962 merkle trees + proofs (reference crypto/merkle/{tree,proof}.go).
+
+Domain-separated hashing: leaf = SHA256(0x00 || item), inner = SHA256(0x01 || l || r).
+Empty tree hashes to SHA256(""). Split point is the largest power of two < n
+(reference crypto/merkle/tree.go:85 getSplitPoint).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+# Bound on proof depth, as in the reference (crypto/merkle/proof.go:14
+# MaxAunts=100): rejects adversarial proofs instead of recursing unboundedly.
+MAX_AUNTS = 100
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def leaf_hash(item: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + item)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """Merkle root (reference crypto/merkle/tree.go:9)."""
+    n = len(items)
+    if n == 0:
+        return _sha256(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+
+
+@dataclass
+class Proof:
+    """Inclusion proof (reference crypto/merkle/proof.go:35)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    def compute_root(self) -> Optional[bytes]:
+        return _root_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0 or self.index >= self.total:
+            return False
+        if len(self.aunts) > MAX_AUNTS or self.total > (1 << MAX_AUNTS):
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        return self.compute_root() == root
+
+
+def _root_from_aunts(index: int, total: int, lh: bytes, aunts: List[bytes]) -> Optional[bytes]:
+    if total == 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return lh
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _root_from_aunts(index, k, lh, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _root_from_aunts(index - k, total - k, lh, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]) -> List[Proof]:
+    """Root + one proof per item (reference crypto/merkle/proof.go:91)."""
+    trails, _ = _trails_from_byte_slices(list(items))
+    total = len(items)
+    proofs = []
+    for i, trail in enumerate(trails):
+        node, aunts = trail, []
+        cur = trail
+        while cur.parent is not None:
+            sib = cur.sibling
+            if sib is not None:
+                aunts.append(sib.hash)
+            cur = cur.parent
+        proofs.append(Proof(total=total, index=i, leaf_hash=node.hash, aunts=aunts))
+    return proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "sibling")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent: Optional[_Node] = None
+        self.sibling: Optional[_Node] = None
+
+
+def _trails_from_byte_slices(items: List[bytes]):
+    if len(items) == 0:
+        return [], _Node(_sha256(b""))
+    if len(items) == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(len(items))
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.sibling = right_root
+    right_root.parent = root
+    right_root.sibling = left_root
+    return lefts + rights, root

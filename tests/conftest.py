@@ -1,0 +1,12 @@
+"""Test env: force JAX onto CPU with 8 virtual devices so sharding/multi-chip
+paths are exercised without TPU hardware (the driver benches on the real chip).
+Must run before any jax import."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

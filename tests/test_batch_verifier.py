@@ -1,0 +1,74 @@
+"""BatchVerifier seam + regression tests for review findings."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import Ed25519PrivKey, Ed25519PubKey
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.batch import BatchVerifier
+
+
+def _signed(n, seed=0):
+    out = []
+    for i in range(n):
+        pk = Ed25519PrivKey.generate(bytes([seed * 31 + i % 251 + 1]) * 32)
+        msg = f"msg {i}".encode()
+        out.append((pk.pub_key(), msg, pk.sign(msg)))
+    return out
+
+
+@pytest.mark.parametrize("backend", ["jax", "host"])
+def test_batch_verifier_backends_agree(backend):
+    bv = BatchVerifier(backend=backend)
+    cases = _signed(20)
+    for pub, msg, sig in cases:
+        bv.add(pub, msg, sig)
+    ok, per = bv.verify()
+    assert ok and per.all() and len(per) == 20
+    # corrupt one
+    for i, (pub, msg, sig) in enumerate(cases):
+        bv.add(pub, msg, sig if i != 7 else sig[:-1] + bytes([sig[-1] ^ 1]))
+    ok, per = bv.verify()
+    assert not ok and per.sum() == 19 and not per[7]
+    # verifier reset after verify()
+    assert len(bv) == 0
+    ok, per = bv.verify()
+    assert ok and per.shape == (0,)
+
+
+def test_openssl_path_rejects_x0_sign1_pubkeys():
+    """Regression (consensus-split): x=0 with sign bit 1 encodings must be
+    rejected by the OpenSSL fast path, matching the strict spec + TPU path."""
+    for y in (1, ed.P - 1):
+        pub = (y | 1 << 255).to_bytes(32, "little")
+        s = 7
+        sB = ed._pt_mul(s, (ed.B[0], ed.B[1], 1, ed.B[0] * ed.B[1] % ed.P))
+        sig = ed._pt_encode(sB) + s.to_bytes(32, "little")
+        assert not ed.verify(pub, b"forged", sig)
+        assert not Ed25519PubKey(pub).verify_signature(b"forged", sig)
+        from tendermint_tpu.crypto.ed25519_jax import batch_verify
+
+        assert not batch_verify([pub], [b"forged"], [sig])[0]
+    # the unset-sign siblings are legitimately decodable points — paths agree
+    for y in (1, ed.P - 1):
+        pub = y.to_bytes(32, "little")
+        assert ed._pt_decode(pub) is not None
+
+
+def test_merkle_adversarial_proof_returns_false():
+    """Regression (DoS): huge total/aunts must be rejected, not recurse."""
+    items = [b"leaf"]
+    root = merkle.hash_from_byte_slices(items)
+    evil = merkle.Proof(
+        total=2**5000, index=0, leaf_hash=merkle.leaf_hash(b"leaf"),
+        aunts=[b"\x00" * 32] * 5000,
+    )
+    assert evil.verify(root, b"leaf") is False
+
+
+def test_batch_verify_length_mismatch_raises():
+    from tendermint_tpu.crypto.ed25519_jax import batch_verify
+
+    with pytest.raises(ValueError):
+        batch_verify([b"\x00" * 32], [], [b"\x00" * 64])

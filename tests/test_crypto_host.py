@@ -1,0 +1,144 @@
+"""Host ed25519 + merkle tests: RFC 8032 vectors, OpenSSL cross-check,
+adversarial acceptance cases (the spec the TPU path must match)."""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+from tendermint_tpu.crypto import (
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    address_hash,
+)
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.crypto import merkle
+
+# (seed, pub, msg, sig) — RFC 8032 §7.1 TEST 1-3
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e065224901555fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_vectors(seed, pub, msg, sig):
+    seed, pub, msg, sig = (bytes.fromhex(x) for x in (seed, pub, msg, sig))
+    assert ed.pubkey_from_seed(seed) == pub
+    assert ed.sign(seed + pub, msg) == sig
+    assert ed.verify(pub, msg, sig)
+    assert not ed.verify(pub, msg + b"x", sig)
+
+
+def test_sign_verify_roundtrip_random():
+    rng = random.Random(7)
+    for _ in range(20):
+        priv, pub = ed.keygen(bytes(rng.randrange(256) for _ in range(32)))
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200)))
+        sig = ed.sign(priv, msg)
+        assert ed.verify(pub, msg, sig)
+        bad = bytearray(sig)
+        bad[rng.randrange(64)] ^= 1 << rng.randrange(8)
+        assert not ed.verify(pub, msg, bytes(bad))
+
+
+def test_cross_check_openssl():
+    cryptography = pytest.importorskip("cryptography")
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    rng = random.Random(13)
+    for _ in range(10):
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        osk = Ed25519PrivateKey.from_private_bytes(seed)
+        opub = osk.public_key().public_bytes_raw()
+        msg = bytes(rng.randrange(256) for _ in range(50))
+        osig = osk.sign(msg)
+        assert ed.pubkey_from_seed(seed) == opub
+        assert ed.sign(seed + opub, msg) == osig
+        assert ed.verify(opub, msg, osig)
+
+
+def test_rejects_noncanonical_s():
+    priv, pub = ed.keygen(b"\x01" * 32)
+    msg = b"hello"
+    sig = ed.sign(priv, msg)
+    s = int.from_bytes(sig[32:], "little")
+    bad = sig[:32] + (s + ed.L).to_bytes(32, "little")
+    assert not ed.verify(pub, msg, bad)
+    # also via the PubKey interface (OpenSSL path must agree)
+    assert not Ed25519PubKey(pub).verify_signature(msg, bad)
+    assert Ed25519PubKey(pub).verify_signature(msg, sig)
+
+
+def test_rejects_noncanonical_pubkey():
+    # y >= p: craft encoding of p+3 (y=p+3 is < 2^255, not a canonical field elt)
+    bad_pub = (ed.P + 3).to_bytes(32, "little")
+    assert not ed.verify(bad_pub, b"m", b"\x00" * 64)
+    assert not Ed25519PubKey(bad_pub).verify_signature(b"m", b"\x00" * 64)
+
+
+def test_rejects_off_curve_pubkey():
+    # find a y whose x^2 has no root
+    y = 2
+    while True:
+        enc = y.to_bytes(32, "little")
+        if ed._pt_decode(enc) is None:
+            break
+        y += 1
+    assert not ed.verify(enc, b"m", b"\x00" * 64)
+
+
+def test_pubkey_interface_matches_reference_shapes():
+    pk = Ed25519PrivKey.generate(b"\x02" * 32)
+    pub = pk.pub_key()
+    assert len(pk.bytes()) == 64
+    assert len(pub.bytes()) == 32
+    assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+    sig = pk.sign(b"msg")
+    assert len(sig) == 64
+    assert pub.verify_signature(b"msg", sig)
+
+
+# --- merkle ----------------------------------------------------------------
+
+def test_merkle_empty_and_single():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    item = b"tx1"
+    assert merkle.hash_from_byte_slices([item]) == hashlib.sha256(b"\x00" + item).digest()
+
+
+def test_merkle_two():
+    a, b = b"a", b"b"
+    la = hashlib.sha256(b"\x00" + a).digest()
+    lb = hashlib.sha256(b"\x00" + b).digest()
+    assert merkle.hash_from_byte_slices([a, b]) == hashlib.sha256(b"\x01" + la + lb).digest()
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 100])
+def test_merkle_proofs(n):
+    items = [f"item{i}".encode() for i in range(n)]
+    root = merkle.hash_from_byte_slices(items)
+    proofs = merkle.proofs_from_byte_slices(items)
+    assert len(proofs) == n
+    for i, pr in enumerate(proofs):
+        assert pr.verify(root, items[i])
+        if n > 1:
+            assert not pr.verify(root, items[(i + 1) % n])
+        assert not pr.verify(os.urandom(32), items[i])

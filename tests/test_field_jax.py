@@ -1,0 +1,116 @@
+"""Differential tests: JAX GF(2^255-19) limb arithmetic vs Python ints."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.ed25519_jax import field as F
+
+P = F.P_INT
+
+
+def _pack(vals):
+    """list[int] -> (17, N) device array."""
+    import jax.numpy as jnp
+
+    arr = np.stack([F.int_to_limbs(v % P) for v in vals], axis=1)
+    return jnp.asarray(arr)
+
+
+def _unpack(a):
+    arr = np.asarray(a)
+    return [F.limbs_to_int(arr[:, i]) for i in range(arr.shape[1])]
+
+
+# values that stress carries, folds and the canonical boundary
+EDGE = [0, 1, 2, 19, 38, 2**15 - 1, 2**15, 2**255 - 20, P - 1, P - 2,
+        2**254, 2**255 - 1 - 19, 12345678901234567890]
+
+
+def _rand_vals(n, seed):
+    rng = random.Random(seed)
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def test_pack_roundtrip():
+    vals = EDGE + _rand_vals(50, 1)
+    assert _unpack(_pack(vals)) == [v % P for v in vals]
+
+
+def test_bytes_to_limbs_roundtrip():
+    vals = EDGE + _rand_vals(50, 2)
+    b = np.stack([
+        np.frombuffer((v % P).to_bytes(32, "little"), dtype=np.uint8) for v in vals
+    ])
+    limbs = F.bytes_to_limbs(b)
+    assert [F.limbs_to_int(limbs[:, i]) for i in range(len(vals))] == [v % P for v in vals]
+    back = F.limbs_to_bytes(limbs)
+    assert np.array_equal(back, b)
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (F.add, lambda a, b: (a + b) % P),
+    (F.sub, lambda a, b: (a - b) % P),
+    (F.mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    avals = EDGE + _rand_vals(64, 3)
+    bvals = list(reversed(EDGE)) + _rand_vals(64, 4)
+    out = _unpack(F.freeze(op(_pack(avals), _pack(bvals))))
+    assert out == [pyop(a, b) for a, b in zip(avals, bvals)]
+
+
+def test_mul_chain_stays_normalized():
+    # repeated muls/adds/subs must preserve the limb invariant
+    vals = _rand_vals(32, 5)
+    a = _pack(vals)
+    acc = [v for v in vals]
+    x = a
+    for i in range(20):
+        x = F.mul(x, a) if i % 3 else F.sub(F.add(x, x), a)
+        acc = [((v * w) if i % 3 else (2 * v - w)) % P for v, w in zip(acc, vals)]
+    assert _unpack(F.freeze(x)) == acc
+    assert int(np.asarray(x).max()) <= 2**15 + 2
+
+
+def test_neg_sqr_mul_small():
+    vals = EDGE + _rand_vals(20, 6)
+    a = _pack(vals)
+    assert _unpack(F.freeze(F.neg(a))) == [(-v) % P for v in vals]
+    assert _unpack(F.freeze(F.sqr(a))) == [v * v % P for v in vals]
+    assert _unpack(F.freeze(F.mul_small(a, 121666))) == [v * 121666 % P for v in vals]
+
+
+def test_freeze_canonical_unique():
+    # adversarial: limb patterns with redundancy (value >= p, limbs near 2^15)
+    import jax.numpy as jnp
+
+    raws = [
+        np.full(17, 2**15 - 1, dtype=np.uint32),        # 2^255 - 1
+        F.int_to_limbs(P - 1) + np.array([19] + [0] * 16, dtype=np.uint32),  # == p+18
+        np.full(17, 2**20, dtype=np.uint32),            # big columns
+        F.P_LIMBS.copy(),                               # exactly p
+        F.TWO_P_LIMBS.copy(),                           # exactly 2p
+    ]
+    arr = jnp.asarray(np.stack(raws, axis=1))
+    out = np.asarray(F.freeze(arr))
+    expect = [F.limbs_to_int(r) % P for r in raws]
+    assert [F.limbs_to_int(out[:, i]) for i in range(len(raws))] == expect
+    assert out.max() < 2**15
+
+
+def test_inverse_and_pow():
+    vals = [1, 2, P - 1] + _rand_vals(20, 7)
+    a = _pack(vals)
+    inv = _unpack(F.freeze(F.inverse(a)))
+    assert inv == [pow(v, P - 2, P) for v in vals]
+    p58 = _unpack(F.freeze(F.pow_p58(a)))
+    assert p58 == [pow(v, (P - 5) // 8, P) for v in vals]
+
+
+def test_eq_is_zero_parity():
+    a = _pack([0, 5, P - 1])
+    z = np.asarray(F.is_zero(a))
+    assert list(z) == [True, False, False]
+    assert list(np.asarray(F.parity(a))) == [0, 1, 0]
