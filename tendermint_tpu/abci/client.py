@@ -1,0 +1,315 @@
+"""ABCI clients (reference abci/client/).
+
+`LocalClient` wraps an in-process Application behind one mutex — the
+reference's local_client.go:15 semantics (all connections share the lock).
+`SocketClient` speaks the length-prefixed protobuf-free JSON framing of our
+socket server (abci/server.py) for out-of-process apps.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from dataclasses import asdict, is_dataclass
+from typing import Any, Callable, Optional
+
+from . import types as abci
+from .application import Application
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class Client:
+    """Synchronous call interface; async pipelining is layered above
+    (state execution collects futures via callbacks)."""
+
+    def echo(self, msg: str) -> str:
+        raise NotImplementedError
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        raise NotImplementedError
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        raise NotImplementedError
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        raise NotImplementedError
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        raise NotImplementedError
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        raise NotImplementedError
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        raise NotImplementedError
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        raise NotImplementedError
+
+    def commit(self) -> abci.ResponseCommit:
+        raise NotImplementedError
+
+    def list_snapshots(self, req: abci.RequestListSnapshots) -> abci.ResponseListSnapshots:
+        raise NotImplementedError
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        raise NotImplementedError
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk) -> abci.ResponseLoadSnapshotChunk:
+        raise NotImplementedError
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk) -> abci.ResponseApplySnapshotChunk:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class LocalClient(Client):
+    """In-proc app behind a shared mutex (abci/client/local_client.go:15)."""
+
+    def __init__(self, app: Application, mtx: Optional[threading.RLock] = None):
+        self._app = app
+        self._mtx = mtx or threading.RLock()
+
+    def echo(self, msg: str) -> str:
+        return msg
+
+    def info(self, req):
+        with self._mtx:
+            return self._app.info(req)
+
+    def init_chain(self, req):
+        with self._mtx:
+            return self._app.init_chain(req)
+
+    def query(self, req):
+        with self._mtx:
+            return self._app.query(req)
+
+    def check_tx(self, req):
+        with self._mtx:
+            return self._app.check_tx(req)
+
+    def begin_block(self, req):
+        with self._mtx:
+            return self._app.begin_block(req)
+
+    def deliver_tx(self, req):
+        with self._mtx:
+            return self._app.deliver_tx(req)
+
+    def end_block(self, req):
+        with self._mtx:
+            return self._app.end_block(req)
+
+    def commit(self):
+        with self._mtx:
+            return self._app.commit()
+
+    def list_snapshots(self, req):
+        with self._mtx:
+            return self._app.list_snapshots(req)
+
+    def offer_snapshot(self, req):
+        with self._mtx:
+            return self._app.offer_snapshot(req)
+
+    def load_snapshot_chunk(self, req):
+        with self._mtx:
+            return self._app.load_snapshot_chunk(req)
+
+    def apply_snapshot_chunk(self, req):
+        with self._mtx:
+            return self._app.apply_snapshot_chunk(req)
+
+
+# --- wire helpers shared with abci/server.py -------------------------------
+
+def _to_jsonable(obj: Any) -> Any:
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _to_jsonable(v) for k, v in asdict(obj).items()}
+    if isinstance(obj, bytes):
+        return {"__b": obj.hex()}
+    if isinstance(obj, list):
+        return [_to_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+def _from_jsonable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"__b"}:
+            return bytes.fromhex(obj["__b"])
+        return {k: _from_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_jsonable(x) for x in obj]
+    return obj
+
+
+def write_frame(sock: socket.socket, payload: dict) -> None:
+    raw = json.dumps(_to_jsonable(payload)).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def read_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (ln,) = struct.unpack(">I", hdr)
+    raw = _read_exact(sock, ln)
+    if raw is None:
+        return None
+    return _from_jsonable(json.loads(raw.decode("utf-8")))
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+_REQ_TYPES = {
+    "info": abci.RequestInfo, "init_chain": abci.RequestInitChain,
+    "query": abci.RequestQuery, "check_tx": abci.RequestCheckTx,
+    "begin_block": abci.RequestBeginBlock, "deliver_tx": abci.RequestDeliverTx,
+    "end_block": abci.RequestEndBlock, "commit": None,
+    "list_snapshots": abci.RequestListSnapshots,
+    "offer_snapshot": abci.RequestOfferSnapshot,
+    "load_snapshot_chunk": abci.RequestLoadSnapshotChunk,
+    "apply_snapshot_chunk": abci.RequestApplySnapshotChunk,
+    "echo": None, "flush": None,
+}
+
+_RESP_TYPES = {
+    "info": abci.ResponseInfo, "init_chain": abci.ResponseInitChain,
+    "query": abci.ResponseQuery, "check_tx": abci.ResponseCheckTx,
+    "begin_block": abci.ResponseBeginBlock, "deliver_tx": abci.ResponseDeliverTx,
+    "end_block": abci.ResponseEndBlock, "commit": abci.ResponseCommit,
+    "list_snapshots": abci.ResponseListSnapshots,
+    "offer_snapshot": abci.ResponseOfferSnapshot,
+    "load_snapshot_chunk": abci.ResponseLoadSnapshotChunk,
+    "apply_snapshot_chunk": abci.ResponseApplySnapshotChunk,
+}
+
+
+def _rebuild(cls, data):
+    """Shallow dataclass reconstruction — nested dataclasses rebuilt where typed."""
+    if cls is None or data is None:
+        return data
+    import dataclasses
+    import typing
+
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        t = hints.get(f.name)
+        origin = typing.get_origin(t)
+        if origin is list and v is not None:
+            (item_t,) = typing.get_args(t)
+            if dataclasses.is_dataclass(item_t):
+                v = [_rebuild(item_t, x) for x in v]
+        elif dataclasses.is_dataclass(t) and isinstance(v, dict):
+            v = _rebuild(t, v)
+        elif origin is typing.Union and v is not None and isinstance(v, dict):
+            args = [a for a in typing.get_args(t) if dataclasses.is_dataclass(a)]
+            if args:
+                v = _rebuild(args[0], v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+class SocketClient(Client):
+    """Length-prefixed framed client for out-of-process apps (reference
+    abci/client/socket_client.go:27 — framing is ours, semantics theirs)."""
+
+    def __init__(self, addr: str):
+        self._addr = addr
+        self._sock = _dial(addr)
+        self._mtx = threading.Lock()
+
+    def _call(self, method: str, req: Any = None) -> Any:
+        with self._mtx:
+            write_frame(self._sock, {"method": method,
+                                     "request": _to_jsonable(req) if req is not None else None})
+            resp = read_frame(self._sock)
+        if resp is None:
+            raise ABCIClientError(f"connection closed during {method}")
+        if resp.get("error"):
+            raise ABCIClientError(resp["error"])
+        return _rebuild(_RESP_TYPES.get(method), resp.get("response"))
+
+    def echo(self, msg: str) -> str:
+        with self._mtx:
+            write_frame(self._sock, {"method": "echo", "request": {"message": msg}})
+            resp = read_frame(self._sock)
+        return (resp or {}).get("response", {}).get("message", "")
+
+    def info(self, req):
+        return self._call("info", req)
+
+    def init_chain(self, req):
+        return self._call("init_chain", req)
+
+    def query(self, req):
+        return self._call("query", req)
+
+    def check_tx(self, req):
+        return self._call("check_tx", req)
+
+    def begin_block(self, req):
+        return self._call("begin_block", req)
+
+    def deliver_tx(self, req):
+        return self._call("deliver_tx", req)
+
+    def end_block(self, req):
+        return self._call("end_block", req)
+
+    def commit(self):
+        return self._call("commit")
+
+    def list_snapshots(self, req):
+        return self._call("list_snapshots", req)
+
+    def offer_snapshot(self, req):
+        return self._call("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req):
+        return self._call("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req):
+        return self._call("apply_snapshot_chunk", req)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _dial(addr: str) -> socket.socket:
+    if addr.startswith("unix://"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(addr[len("unix://"):])
+        return s
+    host, port = addr.replace("tcp://", "").rsplit(":", 1)
+    s = socket.create_connection((host, int(port)))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s

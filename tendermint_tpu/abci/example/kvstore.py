@@ -1,0 +1,117 @@
+"""kvstore example app (reference abci/example/kvstore/).
+
+Txs are "key=value" (or raw bytes stored under themselves). The persistent
+variant accepts validator-update txs: "val:<pubkey_hex>!<power>" — mirroring
+the reference's persistent_kvstore (abci/example/kvstore/persistent_kvstore.go).
+State hash = big-endian tx count (kvstore.go State.Hash semantics: size-based
+deterministic app hash).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional
+
+from .. import types as abci
+from ..application import Application
+
+VALIDATOR_TX_PREFIX = "val:"
+
+
+class KVStoreApplication(Application):
+    def __init__(self):
+        self.state: Dict[str, str] = {}
+        self.tx_count = 0  # deterministic state size counter
+        self.height = 0
+        self.app_hash = b""
+        self.val_updates: List[abci.ValidatorUpdate] = []
+        self.validators: Dict[str, int] = {}  # pubkey hex -> power
+
+    # -- info --
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"size": self.tx_count}),
+            version="0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/store" or req.path == "":
+            key = req.data.decode("utf-8", errors="replace")
+            val = self.state.get(key)
+            if val is None:
+                return abci.ResponseQuery(code=0, key=req.data, log="does not exist",
+                                          height=self.height)
+            return abci.ResponseQuery(code=0, key=req.data, value=val.encode(),
+                                      log="exists", height=self.height)
+        if req.path == "/val":
+            power = self.validators.get(req.data.decode(), 0)
+            return abci.ResponseQuery(code=0, key=req.data,
+                                      value=str(power).encode(), height=self.height)
+        return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+    # -- mempool --
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if tx_is_validator_update(req.tx) and parse_validator_tx(req.tx) is None:
+            return abci.ResponseCheckTx(code=1, log="malformed validator tx")
+        return abci.ResponseCheckTx(code=0, gas_wanted=1)
+
+    # -- consensus --
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self.validators[vu.pub_key_bytes.hex()] = vu.power
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self.val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if tx_is_validator_update(req.tx):
+            parsed = parse_validator_tx(req.tx)
+            if parsed is None:
+                return abci.ResponseDeliverTx(code=1, log="malformed validator tx")
+            pubkey_hex, power = parsed
+            self.validators[pubkey_hex] = power
+            self.val_updates.append(abci.ValidatorUpdate(
+                pub_key_type="ed25519", pub_key_bytes=bytes.fromhex(pubkey_hex), power=power))
+        else:
+            raw = req.tx.decode("utf-8", errors="replace")
+            if "=" in raw:
+                k, v = raw.split("=", 1)
+            else:
+                k = v = raw
+            self.state[k] = v
+        self.tx_count += 1
+        events = [abci.Event(type="app", attributes=[
+            abci.EventAttribute(b"creator", b"tendermint_tpu", True),
+            abci.EventAttribute(b"key", req.tx.split(b"=", 1)[0], True),
+        ])]
+        return abci.ResponseDeliverTx(code=0, events=events, gas_wanted=1, gas_used=1)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return abci.ResponseEndBlock(validator_updates=list(self.val_updates))
+
+    def commit(self) -> abci.ResponseCommit:
+        self.height += 1
+        self.app_hash = self.tx_count.to_bytes(8, "big")
+        return abci.ResponseCommit(data=self.app_hash)
+
+
+def tx_is_validator_update(tx: bytes) -> bool:
+    return tx.decode("utf-8", errors="replace").startswith(VALIDATOR_TX_PREFIX)
+
+
+def parse_validator_tx(tx: bytes) -> "Optional[tuple[str, int]]":
+    try:
+        body = tx.decode("utf-8")[len(VALIDATOR_TX_PREFIX):]
+        pubkey_hex, power_s = body.split("!", 1)
+        bytes.fromhex(pubkey_hex)
+        power = int(power_s)
+        if power < 0 or len(bytes.fromhex(pubkey_hex)) != 32:
+            return None
+        return pubkey_hex, power
+    except (ValueError, UnicodeDecodeError):
+        return None

@@ -1,0 +1,91 @@
+"""ABCI socket server: serves an Application to remote SocketClients
+(reference abci/server/socket_server.go:20, with our JSON framing).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from .application import Application
+from .client import _REQ_TYPES, _rebuild, _to_jsonable, read_frame, write_frame
+
+
+class ABCIServer:
+    def __init__(self, addr: str, app: Application):
+        self._addr = addr
+        self._app = app
+        self._listener: Optional[socket.socket] = None
+        self._threads = []
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        if self._addr.startswith("unix://"):
+            path = self._addr[len("unix://"):]
+            if os.path.exists(path):
+                os.unlink(path)
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(path)
+        else:
+            host, port = self._addr.replace("tcp://", "").rsplit(":", 1)
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host, int(port)))
+        self._listener.listen(16)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def bound_port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        # one mutex per server: all connections serialize into the app,
+        # matching the local-client locking discipline
+        while not self._stopped.is_set():
+            try:
+                frame = read_frame(conn)
+            except OSError:
+                return
+            if frame is None:
+                return
+            method = frame.get("method", "")
+            try:
+                resp = self._dispatch(method, frame.get("request"))
+                write_frame(conn, {"response": _to_jsonable(resp)})
+            except Exception as e:  # report, don't kill the conn
+                write_frame(conn, {"error": f"{type(e).__name__}: {e}"})
+
+    def _dispatch(self, method: str, raw_req):
+        if method == "echo":
+            return {"message": (raw_req or {}).get("message", "")}
+        if method == "flush":
+            return {}
+        if method == "commit":
+            return self._app.commit()
+        req_cls = _REQ_TYPES.get(method)
+        if req_cls is None:
+            raise ValueError(f"unknown ABCI method {method!r}")
+        req = _rebuild(req_cls, raw_req or {})
+        return getattr(self._app, method)(req)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass

@@ -137,5 +137,7 @@ class Ed25519PrivKey(PrivKey):
 
 def pubkey_from_type_and_bytes(type_name: str, b: bytes) -> PubKey:
     if type_name == ED25519_TYPE:
+        if len(b) != 32:
+            raise ValueError(f"ed25519 pubkey must be 32 bytes, got {len(b)}")
         return Ed25519PubKey(b)
     raise ValueError(f"unknown pubkey type {type_name!r}")

@@ -67,13 +67,22 @@ class BatchVerifier:
         if backend == "auto":
             backend = "jax" if n >= self._threshold else "host"
 
-        if backend == "jax" and not non_ed:
+        non_ed_idx = {i: pk for i, pk in non_ed}
+        if backend == "jax":
             from .ed25519_jax import batch_verify
 
-            out = batch_verify(pks, msgs, sigs)
+            ed_pos = [i for i in range(n) if i not in non_ed_idx]
+            out = np.zeros(n, dtype=bool)
+            if ed_pos:
+                ed_out = batch_verify([pks[i] for i in ed_pos],
+                                      [msgs[i] for i in ed_pos],
+                                      [sigs[i] for i in ed_pos])
+                out[ed_pos] = ed_out
+            # rare non-ed25519 keys verify on host, verdicts merged by index
+            for i, pub in non_ed_idx.items():
+                out[i] = pub.verify_signature(msgs[i], sigs[i])
         else:
             out = np.zeros(n, dtype=bool)
-            non_ed_idx = {i: pk for i, pk in non_ed}
             for i in range(n):
                 pub = non_ed_idx.get(i) or Ed25519PubKey(pks[i])
                 out[i] = pub.verify_signature(msgs[i], sigs[i])

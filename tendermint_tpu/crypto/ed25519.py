@@ -142,6 +142,8 @@ def _clamp(h: bytes) -> int:
 
 
 def pubkey_from_seed(seed: bytes) -> bytes:
+    if len(seed) != SEED_SIZE:
+        raise ValueError(f"ed25519 seed must be {SEED_SIZE} bytes, got {len(seed)}")
     h = hashlib.sha512(seed).digest()
     a = _clamp(h)
     return _pt_encode(_pt_mul(a, (B[0], B[1], 1, B[0] * B[1] % P)))
@@ -156,6 +158,8 @@ def keygen(seed: Optional[bytes] = None) -> Tuple[bytes, bytes]:
 
 
 def sign(priv: bytes, msg: bytes) -> bytes:
+    if len(priv) != PRIVKEY_SIZE:
+        raise ValueError(f"ed25519 private key must be {PRIVKEY_SIZE} bytes, got {len(priv)}")
     seed, pub = priv[:32], priv[32:]
     h = hashlib.sha512(seed).digest()
     a = _clamp(h)

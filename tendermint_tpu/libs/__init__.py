@@ -1,0 +1,1 @@
+"""Host-side utility libraries (the reference's libs/ tier, SURVEY.md §2.15)."""

@@ -1,0 +1,171 @@
+"""Ordered key-value store behind a tm-db-style interface.
+
+The reference depends on the external tm-db module (goleveldb default —
+SURVEY.md §2.11). Here: `MemDB` (sorted in-memory, tests) and `SQLiteDB`
+(single-file, transactional, ordered BLOB keys) — both support prefix
+iteration and atomic write batches, which is all the stores need.
+"""
+
+from __future__ import annotations
+
+import bisect
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class DB:
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def iterate(self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+                reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+        """Half-open [start, end), ordered by raw bytes."""
+        raise NotImplementedError
+
+    def iterate_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        return self.iterate(prefix, _prefix_end(prefix))
+
+    def write_batch(self, sets: List[Tuple[bytes, bytes]],
+                    deletes: Optional[List[bytes]] = None) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _prefix_end(prefix: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every key with this prefix."""
+    p = bytearray(prefix)
+    while p:
+        if p[-1] != 0xFF:
+            p[-1] += 1
+            return bytes(p)
+        p.pop()
+    return None
+
+
+class MemDB(DB):
+    def __init__(self) -> None:
+        self._data: Dict[bytes, bytes] = {}
+        self._keys: List[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                i = bisect.bisect_left(self._keys, key)
+                self._keys.pop(i)
+
+    def iterate(self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+                reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+        with self._lock:
+            lo = bisect.bisect_left(self._keys, start) if start is not None else 0
+            hi = bisect.bisect_left(self._keys, end) if end is not None else len(self._keys)
+            keys = self._keys[lo:hi]
+        if reverse:
+            keys = list(reversed(keys))
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def write_batch(self, sets, deletes=None) -> None:
+        with self._lock:
+            for k, v in sets:
+                self.set(k, v)
+            for k in deletes or []:
+                self.delete(k)
+
+
+class SQLiteDB(DB):
+    def __init__(self, path: str) -> None:
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)")
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                (key, value))
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def iterate(self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+                reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+        q = "SELECT k, v FROM kv"
+        cond, args = [], []
+        if start is not None:
+            cond.append("k >= ?")
+            args.append(start)
+        if end is not None:
+            cond.append("k < ?")
+            args.append(end)
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY k" + (" DESC" if reverse else "")
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        for k, v in rows:
+            yield bytes(k), bytes(v)
+
+    def write_batch(self, sets, deletes=None) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                sets)
+            if deletes:
+                self._conn.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def open_db(name: str, backend: str = "sqlite", directory: Optional[str] = None) -> DB:
+    """tm-db NewDB equivalent: backend selected by config (config.db_backend)."""
+    if backend in ("mem", "memdb"):
+        return MemDB()
+    if backend in ("sqlite", "goleveldb"):  # goleveldb alias: config compatibility
+        import os
+
+        assert directory is not None, "sqlite backend needs a directory"
+        os.makedirs(directory, exist_ok=True)
+        return SQLiteDB(os.path.join(directory, f"{name}.db"))
+    raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,45 @@
+"""proxy.AppConns: the 4 logical ABCI connections (reference proxy/):
+consensus, mempool, query, snapshot — local clients share one mutex
+(proxy/client.go NewLocalClientCreator), remote ones get a conn each.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .abci.application import Application
+from .abci.client import Client, LocalClient, SocketClient
+
+ClientCreator = Callable[[], Client]
+
+
+def local_client_creator(app: Application) -> ClientCreator:
+    mtx = threading.RLock()
+    return lambda: LocalClient(app, mtx)
+
+
+def socket_client_creator(addr: str) -> ClientCreator:
+    return lambda: SocketClient(addr)
+
+
+class AppConns:
+    """(proxy/multi_app_conn.go)"""
+
+    def __init__(self, creator: ClientCreator):
+        self._creator = creator
+        self.consensus: Optional[Client] = None
+        self.mempool: Optional[Client] = None
+        self.query: Optional[Client] = None
+        self.snapshot: Optional[Client] = None
+
+    def start(self) -> None:
+        self.query = self._creator()
+        self.snapshot = self._creator()
+        self.mempool = self._creator()
+        self.consensus = self._creator()
+
+    def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            if c is not None:
+                c.close()

@@ -1,0 +1,206 @@
+"""state.Store: persists State, ABCIResponses, per-height validator sets and
+consensus params with change-height dedup (reference state/store.go:52).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..abci import types as abci
+from ..libs import protowire as pw
+from ..libs.db import DB
+from ..types import ConsensusParams, ValidatorSet
+from ..types.basic import BlockID, PartSetHeader
+from ..types.block import Consensus
+from .state import State
+
+_STATE_KEY = b"stateKey"
+
+
+def _validators_key(h: int) -> bytes:
+    return b"validatorsKey:" + str(h).encode()
+
+
+def _params_key(h: int) -> bytes:
+    return b"consensusParamsKey:" + str(h).encode()
+
+
+def _abci_responses_key(h: int) -> bytes:
+    return b"abciResponsesKey:" + str(h).encode()
+
+
+@dataclass
+class ABCIResponses:
+    """Responses persisted per height (reference proto/tendermint/state ABCIResponses)."""
+
+    deliver_txs: List[abci.ResponseDeliverTx] = field(default_factory=list)
+    end_block: Optional[abci.ResponseEndBlock] = None
+    begin_block: Optional[abci.ResponseBeginBlock] = None
+
+    def results_hash(self) -> bytes:
+        return abci.last_results_hash(self.deliver_txs)
+
+    def to_json(self) -> bytes:
+        from ..abci.client import _to_jsonable
+
+        return json.dumps(_to_jsonable({
+            "deliver_txs": self.deliver_txs,
+            "end_block": self.end_block,
+            "begin_block": self.begin_block,
+        })).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "ABCIResponses":
+        from ..abci.client import _from_jsonable, _rebuild
+
+        d = _from_jsonable(json.loads(raw.decode()))
+        return ABCIResponses(
+            deliver_txs=[_rebuild(abci.ResponseDeliverTx, x) for x in d.get("deliver_txs") or []],
+            end_block=_rebuild(abci.ResponseEndBlock, d.get("end_block")),
+            begin_block=_rebuild(abci.ResponseBeginBlock, d.get("begin_block")),
+        )
+
+
+# -- State <-> JSON (storage format is ours; byte parity not required here) --
+
+def _state_to_json(s: State) -> bytes:
+    return json.dumps({
+        "chain_id": s.chain_id,
+        "initial_height": s.initial_height,
+        "version_block": s.version.block,
+        "version_app": s.version.app,
+        "last_block_height": s.last_block_height,
+        "last_block_id": {
+            "hash": s.last_block_id.hash.hex(),
+            "total": s.last_block_id.part_set_header.total,
+            "psh_hash": s.last_block_id.part_set_header.hash.hex(),
+        },
+        "last_block_time_ns": s.last_block_time_ns,
+        "next_validators": s.next_validators.encode().hex() if s.next_validators else None,
+        "validators": s.validators.encode().hex() if s.validators else None,
+        "last_validators": s.last_validators.encode().hex() if s.last_validators else None,
+        "last_height_validators_changed": s.last_height_validators_changed,
+        "consensus_params": s.consensus_params.encode().hex(),
+        "last_height_consensus_params_changed": s.last_height_consensus_params_changed,
+        "last_results_hash": s.last_results_hash.hex(),
+        "app_hash": s.app_hash.hex(),
+    }).encode()
+
+
+def _state_from_json(raw: bytes) -> State:
+    d = json.loads(raw.decode())
+
+    def vs(key):
+        return ValidatorSet.decode(bytes.fromhex(d[key])) if d.get(key) else None
+
+    return State(
+        chain_id=d["chain_id"],
+        initial_height=d["initial_height"],
+        version=Consensus(d["version_block"], d["version_app"]),
+        last_block_height=d["last_block_height"],
+        last_block_id=BlockID(
+            bytes.fromhex(d["last_block_id"]["hash"]),
+            PartSetHeader(d["last_block_id"]["total"],
+                          bytes.fromhex(d["last_block_id"]["psh_hash"])),
+        ),
+        last_block_time_ns=d["last_block_time_ns"],
+        next_validators=vs("next_validators"),
+        validators=vs("validators"),
+        last_validators=vs("last_validators"),
+        last_height_validators_changed=d["last_height_validators_changed"],
+        consensus_params=ConsensusParams.decode(bytes.fromhex(d["consensus_params"])),
+        last_height_consensus_params_changed=d["last_height_consensus_params_changed"],
+        last_results_hash=bytes.fromhex(d["last_results_hash"]),
+        app_hash=bytes.fromhex(d["app_hash"]),
+    )
+
+
+class StateStore:
+    def __init__(self, db: DB):
+        self._db = db
+
+    # -- state --
+
+    def load(self) -> Optional[State]:
+        raw = self._db.get(_STATE_KEY)
+        return _state_from_json(raw) if raw is not None else None
+
+    def save(self, state: State) -> None:
+        """Persist state + next validators + params at their change heights
+        (state/store.go:175)."""
+        next_height = state.last_block_height + 1
+        if state.last_block_height == 0:  # genesis bootstrap
+            next_height = state.initial_height
+            self._save_validators(next_height, state.validators)
+        self._save_validators(next_height + 1, state.next_validators)
+        self._save_params(next_height, state.consensus_params,
+                          state.last_height_consensus_params_changed)
+        self._db.set(_STATE_KEY, _state_to_json(state))
+
+    def bootstrap(self, state: State) -> None:
+        """Seed stores from an out-of-band trusted state — state sync
+        (state/store.go Bootstrap)."""
+        height = state.last_block_height
+        if height == 0:
+            height = state.initial_height
+        if height > 0 and state.last_validators is not None and state.last_validators.size() > 0:
+            self._save_validators(height - 1, state.last_validators)
+        self._save_validators(height, state.validators)
+        self._save_validators(height + 1, state.next_validators)
+        self._save_params(height, state.consensus_params,
+                          state.last_height_consensus_params_changed)
+        self._db.set(_STATE_KEY, _state_to_json(state))
+
+    # -- validators (with change-height dedup, state/store.go:289) --
+
+    def _save_validators(self, height: int, vals: ValidatorSet) -> None:
+        self._db.set(_validators_key(height), json.dumps({
+            "last_changed": height, "set": vals.encode().hex(),
+        }).encode())
+
+    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        raw = self._db.get(_validators_key(height))
+        if raw is None:
+            return None
+        d = json.loads(raw.decode())
+        return ValidatorSet.decode(bytes.fromhex(d["set"]))
+
+    # -- consensus params --
+
+    def _save_params(self, height: int, params: ConsensusParams, last_changed: int) -> None:
+        self._db.set(_params_key(height), json.dumps({
+            "last_changed": last_changed, "params": params.encode().hex(),
+        }).encode())
+
+    def load_consensus_params(self, height: int) -> Optional[ConsensusParams]:
+        raw = self._db.get(_params_key(height))
+        if raw is None:
+            return None
+        d = json.loads(raw.decode())
+        return ConsensusParams.decode(bytes.fromhex(d["params"]))
+
+    # -- abci responses --
+
+    def save_abci_responses(self, height: int, responses: ABCIResponses) -> None:
+        self._db.set(_abci_responses_key(height), responses.to_json())
+
+    def load_abci_responses(self, height: int) -> Optional[ABCIResponses]:
+        raw = self._db.get(_abci_responses_key(height))
+        return ABCIResponses.from_json(raw) if raw is not None else None
+
+    def prune_states(self, retain_height: int) -> None:
+        """Drop per-height records below retain_height (state/store.go PruneStates)."""
+        deletes: List[bytes] = []
+        for key_fn in (_validators_key, _params_key, _abci_responses_key):
+            prefix = key_fn(0).rsplit(b":", 1)[0] + b":"
+            for k, _ in self._db.iterate_prefix(prefix):
+                try:
+                    h = int(k.rsplit(b":", 1)[1])
+                except ValueError:
+                    continue
+                if h < retain_height:
+                    deletes.append(k)
+        if deletes:
+            self._db.write_batch([], deletes)

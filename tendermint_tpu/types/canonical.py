@@ -1,0 +1,65 @@
+"""Canonical sign-bytes (reference types/canonical.go + proto canonical.pb.go).
+
+These are the exact bytes validators sign and verifiers check — the payload of
+the TPU batch-verify hot path. Encoding quirks that matter (verified against
+canonical.pb.go:517-567):
+
+* height/round are sfixed64 little-endian, omitted when zero;
+* the Timestamp field is non-nullable: ALWAYS emitted, even for zero time;
+* CanonicalBlockID is a nullable pointer: omitted for nil/zero block ids;
+* inside CanonicalBlockID the part_set_header is non-nullable: always emitted;
+* the whole message is varint length-prefixed (libs/protoio MarshalDelimited).
+"""
+
+from __future__ import annotations
+
+from ..libs import protowire as pw
+from .basic import BlockID, SignedMsgType
+
+
+def canonical_block_id_bytes(block_id: BlockID) -> "bytes | None":
+    if block_id.is_zero():
+        return None
+    w = pw.Writer()
+    w.bytes(1, block_id.hash)
+    w.message(2, block_id.part_set_header.encode())
+    return w.finish()
+
+
+def vote_sign_bytes(
+    chain_id: str,
+    vote_type: SignedMsgType,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalVote, length-delimited (types/vote.go:93 VoteSignBytes)."""
+    w = pw.Writer()
+    w.varint(1, int(vote_type))
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.message_opt(4, canonical_block_id_bytes(block_id))
+    w.message(5, pw.timestamp(timestamp_ns))
+    w.string(6, chain_id)
+    return pw.length_delimited(w.finish())
+
+
+def proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalProposal, length-delimited (types/proposal.go ProposalSignBytes)."""
+    w = pw.Writer()
+    w.varint(1, int(SignedMsgType.PROPOSAL))
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.varint(4, pol_round)  # int64 varint (canonical.proto:25)
+    w.message_opt(5, canonical_block_id_bytes(block_id))
+    w.message(6, pw.timestamp(timestamp_ns))
+    w.string(7, chain_id)
+    return pw.length_delimited(w.finish())

@@ -1,0 +1,100 @@
+"""Pubsub query language + EventBus routing (reference libs/pubsub, types/event_bus.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.libs.pubsub import PubSubServer, Query, SubscriptionCanceled
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types import events as tme
+
+
+def test_query_parsing_and_matching():
+    q = Query("tm.event='NewBlock'")
+    assert q.matches({"tm.event": ["NewBlock"]})
+    assert not q.matches({"tm.event": ["Tx"]})
+    assert not q.matches({})
+
+    q = Query("tm.event='Tx' AND tx.height>5")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["6"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["5"]})
+
+    q = Query("tx.hash EXISTS")
+    assert q.matches({"tx.hash": ["AB"]})
+    assert not q.matches({})
+
+    q = Query("app.key CONTAINS 'ell'")
+    assert q.matches({"app.key": ["hello"]})
+    assert not q.matches({"app.key": ["world"]})
+
+    # any-value semantics over repeated keys
+    q = Query("app.key='x'")
+    assert q.matches({"app.key": ["y", "x"]})
+
+
+def test_query_parse_errors():
+    with pytest.raises(ValueError):
+        Query("tm.event=")
+    with pytest.raises(ValueError):
+        Query("tm.event='a' OR tm.event='b'")
+
+
+def test_pubsub_routing():
+    async def run():
+        srv = PubSubServer()
+        sub = srv.subscribe("c1", Query("tm.event='A'"))
+        srv.publish("one", {"tm.event": ["A"]})
+        srv.publish("two", {"tm.event": ["B"]})
+        srv.publish("three", {"tm.event": ["A"]})
+        assert (await sub.next()).data == "one"
+        assert (await sub.next()).data == "three"
+        srv.unsubscribe("c1", Query("tm.event='A'"))
+        with pytest.raises(SubscriptionCanceled):
+            await sub.next()
+
+    asyncio.run(run())
+
+
+def test_pubsub_capacity_cancels_slow_subscriber():
+    async def run():
+        srv = PubSubServer()
+        sub = srv.subscribe("slow", Query("tm.event='A'"), out_capacity=2)
+        for _ in range(3):
+            srv.publish("x", {"tm.event": ["A"]})
+        # third publish overflowed → canceled
+        await sub.next()
+        await sub.next()
+        with pytest.raises(SubscriptionCanceled):
+            await sub.next()
+
+    asyncio.run(run())
+
+
+def test_event_bus_tx_events():
+    async def run():
+        bus = EventBus()
+        sub = bus.subscribe("test", "tm.event='Tx' AND tx.height=5")
+        from tendermint_tpu.abci.types import ResponseDeliverTx
+
+        bus.publish_event_tx(5, 0, b"hello", ResponseDeliverTx())
+        bus.publish_event_tx(6, 0, b"other", ResponseDeliverTx())
+        msg = await sub.next()
+        assert msg.data.height == 5 and msg.data.tx == b"hello"
+        assert sub.queue.empty()
+
+    asyncio.run(run())
+
+
+def test_event_bus_app_event_keys():
+    async def run():
+        bus = EventBus()
+        sub = bus.subscribe("test", "app.creator='alice'")
+        from tendermint_tpu.abci.types import Event, EventAttribute, ResponseDeliverTx
+
+        res = ResponseDeliverTx(events=[Event(type="app", attributes=[
+            EventAttribute(b"creator", b"alice", True)])])
+        bus.publish_event_tx(1, 0, b"t", res)
+        msg = await sub.next()
+        assert msg.data.tx == b"t"
+
+    asyncio.run(run())

@@ -1,0 +1,223 @@
+"""ValidatorSet behavior: proposer rotation determinism, updates, and the
+batched VerifyCommit{,Light,LightTrusting} variants (reference
+types/validator_set.go:107-821).
+"""
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.types import (
+    BlockID,
+    BlockIDFlag,
+    PartSetHeader,
+    SignedMsgType,
+    Validator,
+    ValidatorSet,
+    ZERO_TIME_NS,
+)
+from tendermint_tpu.types.block import Commit, CommitSig
+from tendermint_tpu.types.errors import (
+    ErrInvalidCommitHeight,
+    ErrInvalidCommitSignatures,
+    ErrNotEnoughVotingPowerSigned,
+    ErrWrongSignature,
+)
+from tendermint_tpu.types.validator import new_validator
+
+CHAIN_ID = "test_chain_id"
+
+
+def make_vals(n, power=10):
+    privs = [crypto.Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [new_validator(p.pub_key(), power) for p in privs]
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, by_addr
+
+
+def make_commit(vs: ValidatorSet, privs_by_addr, height=5, round_=0,
+                block_id=None, absent=(), nil=(), corrupt=()):
+    block_id = block_id or BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+    sigs = []
+    ts = 1_700_000_000_000_000_000
+    for i, val in enumerate(vs.validators):
+        if i in absent:
+            sigs.append(CommitSig.new_absent())
+            continue
+        vote_bid = BlockID() if i in nil else block_id
+        flag = BlockIDFlag.NIL if i in nil else BlockIDFlag.COMMIT
+        from tendermint_tpu.types.canonical import vote_sign_bytes
+
+        sb = vote_sign_bytes(CHAIN_ID, SignedMsgType.PRECOMMIT, height, round_, vote_bid, ts)
+        sig = privs_by_addr[val.address].sign(sb)
+        if i in corrupt:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        sigs.append(CommitSig(flag, val.address, ts, sig))
+    return Commit(height, round_, block_id, sigs), block_id
+
+
+class TestProposerRotation:
+    def test_round_robin_equal_power(self):
+        vals, _ = make_vals(3)
+        vs = ValidatorSet(vals)
+        seen = []
+        for _ in range(6):
+            seen.append(vs.get_proposer().address)
+            vs.increment_proposer_priority(1)
+        # each validator proposes exactly twice over 2 full rotations
+        assert sorted(seen[:3]) == sorted(v.address for v in vs.validators)
+        assert seen[:3] == seen[3:6]
+
+    def test_weighted_rotation_frequency(self):
+        # powers 1,2,3 → over 60 rounds proposer counts ∝ power
+        privs = [crypto.Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(3)]
+        vals = [new_validator(p.pub_key(), i + 1) for i, p in enumerate(privs)]
+        vs = ValidatorSet(vals)
+        counts = {}
+        for _ in range(60):
+            a = vs.get_proposer().address
+            counts[a] = counts.get(a, 0) + 1
+            vs.increment_proposer_priority(1)
+        by_power = {v.address: v.voting_power for v in vs.validators}
+        got = sorted(counts.values())
+        assert got == [10, 20, 30], f"{got} vs powers {by_power}"
+
+    def test_deterministic_across_copies(self):
+        vals, _ = make_vals(7)
+        a, b = ValidatorSet(vals), ValidatorSet(vals)
+        for _ in range(20):
+            assert a.get_proposer().address == b.get_proposer().address
+            a.increment_proposer_priority(1)
+            b.increment_proposer_priority(1)
+
+    def test_sorted_by_power_then_address(self):
+        privs = [crypto.Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(5)]
+        vals = [new_validator(p.pub_key(), [5, 1, 5, 3, 2][i]) for i, p in enumerate(privs)]
+        vs = ValidatorSet(vals)
+        powers = [v.voting_power for v in vs.validators]
+        assert powers == sorted(powers, reverse=True)
+        # ties broken by ascending address
+        tied = [v.address for v in vs.validators if v.voting_power == 5]
+        assert tied == sorted(tied)
+
+
+class TestUpdates:
+    def test_add_update_remove(self):
+        vals, _ = make_vals(3)
+        vs = ValidatorSet(vals)
+        newp = crypto.Ed25519PrivKey.generate(b"\x77" * 32)
+        vs.update_with_change_set([new_validator(newp.pub_key(), 42)])
+        assert vs.size() == 4
+        assert vs.total_voting_power() == 72
+        # update power
+        vs.update_with_change_set([new_validator(newp.pub_key(), 1)])
+        assert vs.total_voting_power() == 31
+        # remove
+        vs.update_with_change_set([new_validator(newp.pub_key(), 0)])
+        assert vs.size() == 3
+
+    def test_remove_unknown_fails(self):
+        vals, _ = make_vals(3)
+        vs = ValidatorSet(vals)
+        ghost = crypto.Ed25519PrivKey.generate(b"\x66" * 32)
+        with pytest.raises(ValueError, match="failed to find validator"):
+            vs.update_with_change_set([new_validator(ghost.pub_key(), 0)])
+
+    def test_duplicate_changes_fail(self):
+        vals, _ = make_vals(3)
+        vs = ValidatorSet(vals)
+        p = crypto.Ed25519PrivKey.generate(b"\x55" * 32)
+        with pytest.raises(ValueError, match="duplicate"):
+            vs.update_with_change_set([new_validator(p.pub_key(), 5),
+                                       new_validator(p.pub_key(), 6)])
+
+    def test_empty_set_forbidden(self):
+        vals, _ = make_vals(1)
+        vs = ValidatorSet(vals)
+        with pytest.raises(ValueError, match="empty set"):
+            vs.update_with_change_set([new_validator(vals[0].pub_key, 0)])
+
+
+class TestVerifyCommit:
+    def test_all_good(self):
+        vals, privs = make_vals(10)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs)
+        vs.verify_commit(CHAIN_ID, bid, 5, commit)
+        vs.verify_commit_light(CHAIN_ID, bid, 5, commit)
+        vs.verify_commit_light_trusting(CHAIN_ID, commit, (1, 3))
+
+    def test_some_absent_ok(self):
+        vals, privs = make_vals(10)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs, absent=(1, 2))
+        vs.verify_commit(CHAIN_ID, bid, 5, commit)
+
+    def test_nil_votes_verified_but_not_tallied(self):
+        vals, privs = make_vals(4)
+        vs = ValidatorSet(vals)
+        # 3/4 for block (30 > 2/3*40=26.6), one nil — still passes
+        commit, bid = make_commit(vs, privs, nil=(3,))
+        vs.verify_commit(CHAIN_ID, bid, 5, commit)
+        # 2/4 for block → 20 <= 26 fails
+        commit, bid = make_commit(vs, privs, nil=(2, 3))
+        with pytest.raises(ErrNotEnoughVotingPowerSigned):
+            vs.verify_commit(CHAIN_ID, bid, 5, commit)
+
+    def test_corrupt_sig_error_precedence(self):
+        vals, privs = make_vals(6)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs, corrupt=(4, 2))
+        with pytest.raises(ErrWrongSignature) as ei:
+            vs.verify_commit(CHAIN_ID, bid, 5, commit)
+        assert ei.value.idx == 2  # first bad index wins (validator_set.go:697)
+
+    def test_corrupt_nil_vote_fails_full_but_not_light(self):
+        # a bad signature on a nil vote fails VerifyCommit (checks all) but
+        # not VerifyCommitLight (skips non-ForBlock) — reference semantics.
+        vals, privs = make_vals(5)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs, nil=(4,), corrupt=(4,))
+        with pytest.raises(ErrWrongSignature):
+            vs.verify_commit(CHAIN_ID, bid, 5, commit)
+        vs.verify_commit_light(CHAIN_ID, bid, 5, commit)
+
+    def test_light_ignores_bad_sig_after_quorum(self):
+        # Light exits at 2/3; a corrupt sig positioned after the quorum point
+        # must NOT fail it (validator_set.go:760-768 early return).
+        vals, privs = make_vals(10)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs, corrupt=(9,))
+        vs.verify_commit_light(CHAIN_ID, bid, 5, commit)
+        with pytest.raises(ErrWrongSignature):
+            vs.verify_commit(CHAIN_ID, bid, 5, commit)
+
+    def test_wrong_height(self):
+        vals, privs = make_vals(4)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs)
+        with pytest.raises(ErrInvalidCommitHeight):
+            vs.verify_commit(CHAIN_ID, bid, 6, commit)
+
+    def test_wrong_set_size(self):
+        vals, privs = make_vals(4)
+        vs = ValidatorSet(vals)
+        commit, bid = make_commit(vs, privs)
+        commit.signatures.append(CommitSig.new_absent())
+        with pytest.raises(ErrInvalidCommitSignatures):
+            vs.verify_commit(CHAIN_ID, bid, 5, commit)
+
+    def test_trusting_subset(self):
+        # trusted set = subset of signers; 1/3 of trusted power must sign
+        vals, privs = make_vals(6)
+        full = ValidatorSet(vals)
+        commit, bid = make_commit(full, privs)
+        trusted = ValidatorSet(vals[:3])
+        trusted.verify_commit_light_trusting(CHAIN_ID, commit, (1, 3))
+
+    def test_trusting_insufficient(self):
+        vals, privs = make_vals(6)
+        full = ValidatorSet(vals)
+        commit, bid = make_commit(full, privs, absent=(0, 1, 2))
+        trusted = ValidatorSet(vals[:3])  # none of the trusted signed
+        with pytest.raises(ErrNotEnoughVotingPowerSigned):
+            trusted.verify_commit_light_trusting(CHAIN_ID, commit, (1, 3))

@@ -1,0 +1,123 @@
+"""VoteSet tally semantics (reference types/vote_set.go): dedup, conflicts,
+2/3 majority, peer maj23, MakeCommit."""
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.types import (
+    BlockID,
+    PartSetHeader,
+    SignedMsgType,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.errors import ErrVoteConflictingVotes
+from tendermint_tpu.types.validator import new_validator
+
+CHAIN_ID = "test_chain_id"
+BID = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+OTHER = BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32))
+
+
+@pytest.fixture
+def net():
+    privs = [crypto.Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = [new_validator(p.pub_key(), 10) for p in privs]
+    vs = ValidatorSet(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def mk_vote(vs, privs, idx, block_id, ts=1_700_000_000_000_000_000,
+            type_=SignedMsgType.PRECOMMIT, height=1, round_=0):
+    val = vs.validators[idx]
+    v = Vote(type_, height, round_, block_id, ts, val.address, idx)
+    v.signature = privs[idx].sign(v.sign_bytes(CHAIN_ID))
+    return v
+
+
+def test_two_thirds_majority(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    for i in range(2):
+        assert voteset.add_vote(mk_vote(vs, privs, i, BID))
+    assert not voteset.has_two_thirds_majority()
+    assert voteset.add_vote(mk_vote(vs, privs, 2, BID))
+    maj, ok = voteset.two_thirds_majority()
+    assert ok and maj == BID
+
+
+def test_duplicate_vote_not_added(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    v = mk_vote(vs, privs, 0, BID)
+    assert voteset.add_vote(v)
+    assert voteset.add_vote(v) is False
+
+
+def test_conflicting_vote_raises(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    assert voteset.add_vote(mk_vote(vs, privs, 0, BID))
+    with pytest.raises(ErrVoteConflictingVotes):
+        voteset.add_vote(mk_vote(vs, privs, 0, OTHER))
+
+
+def test_bad_signature_rejected(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    v = mk_vote(vs, privs, 0, BID)
+    v.signature = bytes([v.signature[0] ^ 1]) + v.signature[1:]
+    from tendermint_tpu.types.errors import ErrVoteInvalidSignature
+
+    with pytest.raises(ErrVoteInvalidSignature):
+        voteset.add_vote(v)
+
+
+def test_nil_votes_count_toward_any_but_not_block(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    for i in range(3):
+        voteset.add_vote(mk_vote(vs, privs, i, BlockID()))
+    assert voteset.has_two_thirds_any()
+    maj, ok = voteset.two_thirds_majority()
+    assert ok and maj.is_zero()  # 2/3 for nil IS a majority decision (for nil)
+
+
+def test_make_commit_excludes_other_block_sigs(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    for i in range(3):
+        voteset.add_vote(mk_vote(vs, privs, i, BID))
+    # validator 3 voted for another block — conflicting with nothing (first vote)
+    voteset.add_vote(mk_vote(vs, privs, 3, OTHER))
+    commit = voteset.make_commit()
+    assert commit.block_id == BID
+    assert commit.signatures[3].absent()
+    assert sum(1 for s in commit.signatures if s.for_block()) == 3
+    # commit verifies against the set
+    vs.verify_commit(CHAIN_ID, BID, 1, commit)
+
+
+def test_peer_maj23_tracks_conflicting_block(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    voteset.set_peer_maj23("peer1", OTHER)
+    # conflicting second vote for OTHER is now tracked (peer claims maj23)
+    assert voteset.add_vote(mk_vote(vs, privs, 0, BID))
+    with pytest.raises(ErrVoteConflictingVotes):
+        voteset.add_vote(mk_vote(vs, privs, 0, OTHER))
+    # the vote was recorded under OTHER despite the conflict
+    ba = voteset.bit_array_by_block_id(OTHER)
+    assert ba is not None and ba.get_index(0)
+
+
+def test_wrong_height_rejected(net):
+    vs, privs = net
+    voteset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PRECOMMIT, vs)
+    from tendermint_tpu.types.vote_set import VoteSetError
+
+    with pytest.raises(VoteSetError):
+        voteset.add_vote(mk_vote(vs, privs, 0, BID, height=2))
