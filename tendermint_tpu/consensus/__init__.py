@@ -1,0 +1,11 @@
+"""Consensus engine (reference consensus/, SURVEY.md §2.1).
+
+Single-writer async state machine driving the one-height/many-round Tendermint
+BFT protocol: NewRound → Propose → Prevote → PrevoteWait → Precommit →
+PrecommitWait → Commit, with WAL-before-act crash recovery.
+"""
+
+from .config import ConsensusConfig  # noqa: F401
+from .round_state import HeightVoteSet, RoundState, RoundStep  # noqa: F401
+from .state import ConsensusState  # noqa: F401
+from .wal import WAL, NilWAL  # noqa: F401

@@ -1,0 +1,59 @@
+"""Consensus timing/behaviour config (reference config/config.go:917 ConsensusConfig)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ConsensusConfig:
+    # all times in seconds (float); defaults from config/config.go:996-1010
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    double_sign_check_height: int = 0
+    wal_file: str = ""
+    # gossip sleeps (reactor)
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+
+    def propose(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit_time_ns(self, t_ns: int) -> int:
+        return t_ns + int(self.timeout_commit * 1e9)
+
+    def wait_for_txs(self) -> bool:
+        return not self.create_empty_blocks or self.create_empty_blocks_interval > 0
+
+
+def test_consensus_config() -> ConsensusConfig:
+    """Fast timeouts for in-proc tests (reference config TestConsensusConfig)."""
+    return ConsensusConfig(  # noqa
+        timeout_propose=0.08,
+        timeout_propose_delta=0.05,
+        timeout_prevote=0.01,
+        timeout_prevote_delta=0.01,
+        timeout_precommit=0.01,
+        timeout_precommit_delta=0.01,
+        timeout_commit=0.01,
+        skip_timeout_commit=True,
+        peer_gossip_sleep_duration=0.005,
+        peer_query_maj23_sleep_duration=0.25,
+    )
+
+
+test_consensus_config.__test__ = False  # not a pytest test despite the name

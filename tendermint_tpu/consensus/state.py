@@ -1,0 +1,928 @@
+"""The consensus state machine (reference consensus/state.go:78).
+
+A single async task (`receive_routine`) serializes every input — peer
+messages, our own signed messages, timeouts — exactly like the reference's
+single-goroutine receiveRoutine (state.go:707). State transitions happen only
+inside it. WAL-before-act discipline: every message is logged (fsync for our
+own) before it mutates the round state.
+
+All enter* transitions are synchronous functions: one message is processed
+atomically from queue-pop to quiescence, which is the asyncio equivalent of
+the reference's per-message mutex hold.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from ..state import BlockExecutor, State
+from ..state.store import StateStore
+from ..store import BlockStore
+from ..types import PrivValidator, ValidatorSet
+from ..types.basic import BlockID, PartSetHeader, SignedMsgType
+from ..types.block import Block, Commit
+from ..types.errors import ErrVoteConflictingVotes
+from ..types.event_bus import (
+    EventBus,
+    EventDataCompleteProposal,
+    EventDataNewRound,
+    EventDataRoundState,
+)
+from ..types.part_set import Part, PartSet
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from ..types.vote_set import VoteSetError
+from .config import ConsensusConfig
+from .round_state import (
+    HeightVoteSet,
+    RoundState,
+    RoundStep,
+    commit_to_vote_set,
+)
+from .wal import WAL, NilWAL, TimeoutInfo
+
+logger = logging.getLogger("tmtpu.consensus")
+
+
+# --- messages (consensus/msgs.go domain side) ------------------------------
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+
+@dataclass
+class _MsgInfo:
+    msg: object
+    peer_id: str  # "" == internal
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+class ConsensusState:
+    def __init__(self, config: ConsensusConfig, state: State,
+                 block_exec: BlockExecutor, block_store: BlockStore,
+                 tx_notifier=None, evpool=None,
+                 wal: Optional[WAL] = None):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.tx_notifier = tx_notifier
+        self.evpool = evpool if evpool is not None else block_exec.evpool
+        self.wal: WAL = wal or NilWAL()
+
+        self.rs = RoundState()
+        self.state: State = State()  # set via update_to_state
+
+        self.priv_validator: Optional[PrivValidator] = None
+        self.priv_validator_pub_key = None
+
+        self.event_bus: Optional[EventBus] = None
+        # internal event switch (reference evsw): reactor hooks
+        self.new_round_step_listeners: List[Callable[[RoundState], None]] = []
+        self.valid_block_listeners: List[Callable[[RoundState], None]] = []
+        self.vote_listeners: List[Callable[[Vote], None]] = []
+
+        self._queue: "asyncio.Queue" = asyncio.Queue(maxsize=1000)
+        self._timeout_task: Optional[asyncio.Task] = None
+        self._pending_timeout: Optional[TimeoutInfo] = None
+        self._receive_task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self.n_steps = 0
+        self._replay_mode = False
+
+        self.update_to_state(state)
+        self.reconstruct_last_commit(state)
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_priv_validator(self, pv: Optional[PrivValidator]) -> None:
+        self.priv_validator = pv
+        if pv is not None:
+            self.priv_validator_pub_key = pv.get_pub_key()
+
+    def set_event_bus(self, bus: EventBus) -> None:
+        self.event_bus = bus
+
+    # -- external input (reactor → queues) ---------------------------------
+
+    async def add_peer_msg(self, msg, peer_id: str) -> None:
+        await self._queue.put(_MsgInfo(msg, peer_id))
+
+    def send_internal(self, msg) -> None:
+        """Internal messages must not be dropped (state.go sendInternalMessage)."""
+        self._queue.put_nowait(_MsgInfo(msg, ""))
+
+    async def set_proposal_and_block(self, proposal: Proposal, parts: PartSet,
+                                     peer_id: str) -> None:
+        """Test/replay helper mirroring the reference's blocking variant."""
+        await self.add_peer_msg(ProposalMessage(proposal), peer_id)
+        for i in range(parts.total):
+            await self.add_peer_msg(
+                BlockPartMessage(proposal.height, proposal.round, parts.get_part(i)),
+                peer_id)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """(state.go:299 OnStart — minus WAL catchup, see replay.py)"""
+        self._receive_task = asyncio.create_task(self.receive_routine(),
+                                                 name=f"cs-receive-{id(self)}")
+        self._schedule_round0()
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._timeout_task is not None:
+            self._timeout_task.cancel()
+        if self._receive_task is not None:
+            self._receive_task.cancel()
+            try:
+                await self._receive_task
+            except asyncio.CancelledError:
+                pass
+        self.wal.close()
+
+    def _schedule_round0(self) -> None:
+        sleep_s = max(0.0, (self.rs.start_time_ns - now_ns()) / 1e9)
+        self._schedule_timeout(sleep_s, self.rs.height, 0, RoundStep.NEW_HEIGHT)
+
+    # -- timeout ticker (consensus/ticker.go: one timeout at a time) -------
+
+    def _schedule_timeout(self, duration_s: float, height: int, round_: int,
+                          step: RoundStep) -> None:
+        ti = TimeoutInfo(duration_s, height, round_, int(step))
+        old = self._pending_timeout
+        # newer timeouts for same/later (H,R,S) override (ticker.go timeoutRoutine)
+        if self._timeout_task is not None:
+            self._timeout_task.cancel()
+        self._pending_timeout = ti
+        self._timeout_task = asyncio.create_task(self._fire_timeout(ti))
+
+    async def _fire_timeout(self, ti: TimeoutInfo) -> None:
+        try:
+            await asyncio.sleep(ti.duration_s)
+        except asyncio.CancelledError:
+            return
+        await self._queue.put(ti)
+
+    # -- the single-writer loop (state.go:707) -----------------------------
+
+    async def receive_routine(self) -> None:
+        while not self._stopped:
+            # queue.get() on a non-empty queue does not suspend; without this
+            # yield a busy chain (internal msgs re-enqueue forever) starves
+            # every other task and timer on the loop.
+            await asyncio.sleep(0)
+            item = await self._queue.get()
+            try:
+                if isinstance(item, TimeoutInfo):
+                    self.wal.write_timeout(item, now_ns())
+                    self._handle_timeout(item)
+                elif isinstance(item, _MsgInfo):
+                    self.wal.write_msg_info(item.msg, item.peer_id, now_ns(),
+                                            internal=item.peer_id == "")
+                    self._handle_msg(item)
+                elif item == "txs_available":
+                    self._handle_txs_available()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("error in consensus receive routine "
+                                 "(height=%d round=%d step=%s)",
+                                 self.rs.height, self.rs.round, self.rs.step)
+
+    def _handle_msg(self, mi: _MsgInfo) -> None:
+        """(state.go:799 handleMsg)"""
+        msg, peer_id = mi.msg, mi.peer_id
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            added = self._add_proposal_block_part(msg, peer_id)
+            if added and self.rs.proposal_block_parts.is_complete():
+                self._handle_complete_proposal(msg.height)
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+        else:
+            logger.error("unknown msg type %s", type(msg))
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """(state.go:890 handleTimeout)"""
+        rs = self.rs
+        if (ti.height != rs.height or ti.round < rs.round
+                or (ti.round == rs.round and ti.step < int(rs.step))):
+            return
+        step = RoundStep(ti.step)
+        if step == RoundStep.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif step == RoundStep.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif step == RoundStep.PROPOSE:
+            if self.event_bus:
+                self.event_bus.publish_event_timeout_propose(self._round_state_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif step == RoundStep.PREVOTE_WAIT:
+            if self.event_bus:
+                self.event_bus.publish_event_timeout_wait(self._round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif step == RoundStep.PRECOMMIT_WAIT:
+            if self.event_bus:
+                self.event_bus.publish_event_timeout_wait(self._round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ValueError(f"invalid timeout step: {step}")
+
+    def _handle_txs_available(self) -> None:
+        """(state.go:939 handleTxsAvailable)"""
+        if self.rs.round != 0:
+            return
+        if self.rs.step == RoundStep.NEW_HEIGHT:
+            if self._need_proof_block(self.rs.height):
+                return
+            timeout_commit = (self.rs.start_time_ns - now_ns()) / 1e9 + 0.001
+            self._schedule_timeout(max(timeout_commit, 0.001), self.rs.height, 0,
+                                   RoundStep.NEW_ROUND)
+        elif self.rs.step == RoundStep.NEW_ROUND:
+            self._enter_propose(self.rs.height, 0)
+
+    def notify_txs_available(self) -> None:
+        self._queue.put_nowait("txs_available")
+
+    # -- state update ------------------------------------------------------
+
+    def update_to_state(self, state: State) -> None:
+        """(state.go:574 updateToState)"""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"updateToState() expected state height of {rs.height} but found "
+                f"{state.last_block_height}")
+        if not self.state.is_empty():
+            if (self.state.last_block_height > 0
+                    and self.state.last_block_height + 1 != rs.height):
+                raise RuntimeError(
+                    f"inconsistent cs.state.LastBlockHeight+1 "
+                    f"{self.state.last_block_height + 1} vs cs.Height {rs.height}")
+            if state.last_block_height <= self.state.last_block_height:
+                self._new_step()
+                return
+
+        if state.last_block_height == 0:
+            rs.last_commit = None
+        elif rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if not precommits.has_two_thirds_majority():
+                raise RuntimeError(
+                    f"wanted to form a commit, but precommits (H/R: "
+                    f"{state.last_block_height}/{rs.commit_round}) didn't have 2/3+")
+            rs.last_commit = precommits
+        elif rs.last_commit is None:
+            raise RuntimeError(
+                f"last commit cannot be empty after initial block "
+                f"(H:{state.last_block_height + 1})")
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        rs.height = height
+        rs.round = 0
+        rs.step = RoundStep.NEW_HEIGHT
+        if rs.commit_time_ns == 0:
+            rs.start_time_ns = self.config.commit_time_ns(now_ns())
+        else:
+            rs.start_time_ns = self.config.commit_time_ns(rs.commit_time_ns)
+        rs.validators = state.validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.commit_round = -1
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = state
+        self._new_step()
+
+    def reconstruct_last_commit(self, state: State) -> None:
+        """(state.go:550 reconstructLastCommit)"""
+        if state.last_block_height == 0:
+            return
+        seen_commit = self.block_store.load_seen_commit(state.last_block_height)
+        if seen_commit is None:
+            raise RuntimeError(
+                f"failed to reconstruct last commit; seen commit for height "
+                f"{state.last_block_height} not found")
+        last_precommits = commit_to_vote_set(state.chain_id, seen_commit,
+                                             state.last_validators)
+        if not last_precommits.has_two_thirds_majority():
+            raise RuntimeError("failed to reconstruct last commit; does not have +2/3 maj")
+        self.rs.last_commit = last_precommits
+
+    def _new_step(self) -> None:
+        rs_event = self._round_state_event()
+        self.wal.write_round_step(self.rs.height, self.rs.round, int(self.rs.step),
+                                  now_ns())
+        self.n_steps += 1
+        if self.event_bus is not None:
+            self.event_bus.publish_event_new_round_step(rs_event)
+        for listener in self.new_round_step_listeners:
+            listener(self.rs)
+
+    def _round_state_event(self) -> EventDataRoundState:
+        return EventDataRoundState(self.rs.height, self.rs.round,
+                                   self.rs.step.short_name())
+
+    # -- step transitions --------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """(state.go:976)"""
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT)):
+            return
+        logger.debug("entering new round %d/%d", height, round_)
+
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round for skipping
+        rs.triggered_timeout_precommit = False
+
+        if self.event_bus:
+            proposer = validators.get_proposer()
+            idx, _ = validators.get_by_address(proposer.address)
+            self.event_bus.publish_event_new_round(EventDataNewRound(
+                height, round_, rs.step.short_name(), proposer.address, idx))
+
+        wait_for_txs = (self.config.wait_for_txs() and round_ == 0
+                        and not self._need_proof_block(height))
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(self.config.create_empty_blocks_interval,
+                                       height, round_, RoundStep.NEW_ROUND)
+            # else wait for notify_txs_available
+        else:
+            self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        if height == self.state.initial_height:
+            return True
+        last_meta = self.block_store.load_block_meta(height - 1)
+        if last_meta is None:
+            raise RuntimeError(f"needProofBlock: last block meta for height {height - 1} not found")
+        return self.state.app_hash != last_meta.header.app_hash
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """(state.go:1060)"""
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= RoundStep.PROPOSE)):
+            return
+        logger.debug("entering propose %d/%d", height, round_)
+        try:
+            self._schedule_timeout(self.config.propose(round_), height, round_,
+                                   RoundStep.PROPOSE)
+            if self.priv_validator is None or self.priv_validator_pub_key is None:
+                return
+            address = self.priv_validator_pub_key.address()
+            if not rs.validators.has_address(address):
+                return
+            if rs.validators.get_proposer().address == address:
+                self._decide_proposal(height, round_)
+        finally:
+            rs.round = round_
+            rs.step = RoundStep.PROPOSE
+            self._new_step()
+            if self._is_proposal_complete():
+                self._enter_prevote(height, rs.round)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """(state.go:1124 defaultDecideProposal)"""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            commit: Optional[Commit]
+            if height == self.state.initial_height:
+                commit = Commit(0, 0, BlockID(), [])
+            elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+                commit = rs.last_commit.make_commit()
+            else:
+                logger.error("propose step; cannot propose anything without commit for the previous block")
+                return
+            proposer_addr = self.priv_validator_pub_key.address()
+            block, block_parts = self.block_exec.create_proposal_block(
+                height, self.state, commit, proposer_addr)
+
+        block_id = BlockID(block.hash(), block_parts.header())
+        proposal = Proposal(height, round_, rs.valid_round, block_id, now_ns())
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:
+            if not self._replay_mode:
+                logger.error("propose step; failed signing proposal: %s", e)
+            return
+        self.send_internal(ProposalMessage(proposal))
+        for i in range(block_parts.total):
+            self.send_internal(BlockPartMessage(rs.height, round_, block_parts.get_part(i)))
+        logger.info("signed proposal %d/%d", height, round_)
+
+    def _is_proposal_complete(self) -> bool:
+        """(state.go isProposalComplete)"""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """(state.go:1226)"""
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= RoundStep.PREVOTE)):
+            return
+        logger.debug("entering prevote %d/%d", height, round_)
+        self._do_prevote(height, round_)
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """(state.go:1252 defaultDoPrevote)"""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(SignedMsgType.PREVOTE, rs.locked_block.hash(),
+                                rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(SignedMsgType.PREVOTE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception as e:
+            logger.error("prevote step: ProposalBlock is invalid: %s", e)
+            self._sign_add_vote(SignedMsgType.PREVOTE, b"", PartSetHeader())
+            return
+        self._sign_add_vote(SignedMsgType.PREVOTE, rs.proposal_block.hash(),
+                            rs.proposal_block_parts.header())
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """(state.go:1286)"""
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT)):
+            return
+        if not rs.votes.prevotes(round_).has_two_thirds_any():
+            raise RuntimeError(
+                f"entering prevote wait step ({height}/{round_}), but prevotes "
+                f"does not have any +2/3 votes")
+        logger.debug("entering prevote wait %d/%d", height, round_)
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(self.config.prevote(round_), height, round_,
+                               RoundStep.PREVOTE_WAIT)
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """(state.go:1322)"""
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.step >= RoundStep.PRECOMMIT)):
+            return
+        logger.debug("entering precommit %d/%d", height, round_)
+
+        def done():
+            rs.round = round_
+            rs.step = RoundStep.PRECOMMIT
+            self._new_step()
+
+        block_id, ok = rs.votes.prevotes(round_).two_thirds_majority()
+
+        if not ok:
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
+            done()
+            return
+
+        if self.event_bus:
+            self.event_bus.publish_event_polka(self._round_state_event())
+
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise RuntimeError(f"this POLRound should be {round_} but got {pol_round}")
+
+        # +2/3 prevoted nil: unlock and precommit nil
+        if len(block_id.hash) == 0:
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus:
+                    self.event_bus.publish_event_unlock(self._round_state_event()) \
+                        if hasattr(self.event_bus, "publish_event_unlock") else None
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
+            done()
+            return
+
+        # already locked on this block: relock
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.locked_round = round_
+            if self.event_bus:
+                self.event_bus.publish_event_relock(self._round_state_event())
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id.hash,
+                                block_id.part_set_header)
+            done()
+            return
+
+        # +2/3 prevoted our proposal block: lock it
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            self.block_exec.validate_block(self.state, rs.proposal_block)  # panics on bad
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self.event_bus:
+                self.event_bus.publish_event_lock(self._round_state_event())
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id.hash,
+                                block_id.part_set_header)
+            done()
+            return
+
+        # polka for a block we don't have: unlock, fetch, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.part_set_header):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+        self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
+        done()
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """(state.go:1439)"""
+        rs = self.rs
+        if (rs.height != height or round_ < rs.round
+                or (rs.round == round_ and rs.triggered_timeout_precommit)):
+            return
+        if not rs.votes.precommits(round_).has_two_thirds_any():
+            raise RuntimeError(
+                f"entering precommit wait step ({height}/{round_}), but precommits "
+                f"does not have any +2/3 votes")
+        logger.debug("entering precommit wait %d/%d", height, round_)
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(self.config.precommit(round_), height, round_,
+                               RoundStep.PRECOMMIT_WAIT)
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """(state.go:1476)"""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        logger.debug("entering commit %d/%d", height, commit_round)
+
+        try:
+            block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
+            if not ok:
+                raise RuntimeError("enterCommit expects +2/3 precommits")
+
+            if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+
+            if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+                if (rs.proposal_block_parts is None
+                        or not rs.proposal_block_parts.has_header(block_id.part_set_header)):
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+                    if self.event_bus:
+                        self.event_bus.publish_event_valid_block(self._round_state_event())
+                    for listener in self.valid_block_listeners:
+                        listener(rs)
+        finally:
+            # keep rs.round; commit_round points at the right precommit set
+            rs.step = RoundStep.COMMIT
+            rs.commit_round = commit_round
+            rs.commit_time_ns = now_ns()
+            self._new_step()
+            self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """(state.go:1539)"""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError(f"tryFinalizeCommit() cs.Height: {rs.height} vs {height}")
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if not ok or len(block_id.hash) == 0:
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """(state.go:1567)"""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise RuntimeError("cannot finalize commit; commit does not have 2/3 majority")
+        if not block_parts.has_header(block_id.part_set_header):
+            raise RuntimeError("expected ProposalBlockParts header to be commit header")
+        if block.hash() != block_id.hash:
+            raise RuntimeError("cannot finalize commit; proposal block does not hash to commit hash")
+        self.block_exec.validate_block(self.state, block)
+
+        logger.info("finalizing commit of block height=%d hash=%s txs=%d",
+                    height, block.hash().hex()[:12], len(block.data.txs))
+
+        if self.block_store.height() < block.header.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+
+        # EndHeight implies blockstore has the block (crash recovery pivot).
+        self.wal.write_end_height(height, now_ns())
+
+        state_copy = self.state.copy()
+        state_copy, retain_height = self.block_exec.apply_block(
+            state_copy, BlockID(block.hash(), block_parts.header()), block)
+
+        if retain_height > 0:
+            try:
+                pruned = self.block_store.prune_blocks(retain_height)
+                self.block_exec.state_store.prune_states(retain_height)
+                logger.debug("pruned %d blocks to retain height %d", pruned, retain_height)
+            except Exception as e:
+                logger.error("failed to prune blocks: %s", e)
+
+        self.update_to_state(state_copy)
+        if self.priv_validator is not None:
+            self.priv_validator_pub_key = self.priv_validator.get_pub_key()
+        self._schedule_round0()
+
+    # -- proposals ---------------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """(state.go:1808 defaultSetProposal)"""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (0 <= proposal.pol_round >= proposal.round):
+            raise ValueError("error invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+                proposal.sign_bytes(self.state.chain_id), proposal.signature):
+            raise ValueError("error invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
+        logger.info("received proposal %d/%d", proposal.height, proposal.round)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
+        """(state.go:1850)"""
+        rs = self.rs
+        if rs.height != msg.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
+            raise ValueError(
+                f"total size of proposal block parts exceeds maximum block bytes "
+                f"({rs.proposal_block_parts.byte_size} > "
+                f"{self.state.consensus_params.block.max_bytes})")
+        if added and rs.proposal_block_parts.is_complete():
+            rs.proposal_block = Block.decode(rs.proposal_block_parts.get_reader())
+            logger.info("received complete proposal block height=%d hash=%s",
+                        rs.proposal_block.header.height,
+                        (rs.proposal_block.hash() or b"").hex()[:12])
+            if self.event_bus:
+                self.event_bus.publish_event_complete_proposal(
+                    EventDataCompleteProposal(
+                        rs.height, rs.round, rs.step.short_name(),
+                        BlockID(rs.proposal_block.hash(),
+                                rs.proposal_block_parts.header())))
+        return added
+
+    def _handle_complete_proposal(self, block_height: int) -> None:
+        """(state.go:1911)"""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        block_id, has_two_thirds = (prevotes.two_thirds_majority()
+                                    if prevotes else (BlockID(), False))
+        if (has_two_thirds and not block_id.is_zero() and rs.valid_round < rs.round
+                and rs.proposal_block.hash() == block_id.hash):
+            rs.valid_round = rs.round
+            rs.valid_block = rs.proposal_block
+            rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= RoundStep.PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(block_height, rs.round)
+            if has_two_thirds:
+                self._enter_precommit(block_height, rs.round)
+        elif rs.step == RoundStep.COMMIT:
+            self._try_finalize_commit(block_height)
+
+    # -- votes -------------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """(state.go:1947)"""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as e:
+            if self.priv_validator_pub_key is not None and \
+                    vote.validator_address == self.priv_validator_pub_key.address():
+                logger.error(
+                    "found conflicting vote from ourselves; did you unsafe_reset a validator? "
+                    "height=%d round=%d", vote.height, vote.round)
+                return False
+            if self.evpool is not None:
+                self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
+            logger.debug("found and sent conflicting votes to the evidence pool")
+            return False
+        except VoteSetError as e:
+            logger.info("failed attempting to add vote: %s", e)
+            return False
+        except Exception as e:
+            logger.info("failed attempting to add vote: %s", e)
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """(state.go:1995)"""
+        rs = self.rs
+
+        # A precommit for the previous height (during timeoutCommit wait)
+        if vote.height + 1 == rs.height and vote.type == SignedMsgType.PRECOMMIT:
+            if rs.step != RoundStep.NEW_HEIGHT:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            if self.event_bus:
+                from ..types.event_bus import EventDataVote
+
+                self.event_bus.publish_event_vote(vote)
+            for listener in self.vote_listeners:
+                listener(vote)
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                self._enter_new_round(rs.height, 0)
+            return True
+
+        if vote.height != rs.height:
+            return False
+
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self.event_bus:
+            self.event_bus.publish_event_vote(vote)
+        for listener in self.vote_listeners:
+            listener(vote)
+
+        if vote.type == SignedMsgType.PREVOTE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok:
+                # unlock on newer POL for a different block
+                if (rs.locked_block is not None and rs.locked_round < vote.round
+                        and vote.round <= rs.round
+                        and rs.locked_block.hash() != block_id.hash):
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                # update Valid*
+                if (len(block_id.hash) != 0 and rs.valid_round < vote.round
+                        and vote.round == rs.round):
+                    if (rs.proposal_block is not None
+                            and rs.proposal_block.hash() == block_id.hash):
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if (rs.proposal_block_parts is None
+                            or not rs.proposal_block_parts.has_header(
+                                block_id.part_set_header)):
+                        rs.proposal_block_parts = PartSet.from_header(
+                            block_id.part_set_header)
+                    for listener in self.valid_block_listeners:
+                        listener(rs)
+                    if self.event_bus:
+                        self.event_bus.publish_event_valid_block(self._round_state_event())
+
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+            elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and (self._is_proposal_complete() or len(block_id.hash) == 0):
+                    self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(height, vote.round)
+            elif (rs.proposal is not None and 0 <= rs.proposal.pol_round
+                  and rs.proposal.pol_round == vote.round):
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, rs.round)
+
+        elif vote.type == SignedMsgType.PRECOMMIT:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if len(block_id.hash) != 0:
+                    self._enter_commit(height, vote.round)
+                    if self.config.skip_timeout_commit and precommits.has_all():
+                        self._enter_new_round(rs.height, 0)
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+        else:
+            raise ValueError(f"unexpected vote type {vote.type}")
+        return True
+
+    # -- signing -----------------------------------------------------------
+
+    def _vote_time_ns(self) -> int:
+        """(state.go:2204 voteTime) — BFT time monotonicity."""
+        now = now_ns()
+        min_vote_time = now
+        time_iota_ns = self.state.consensus_params.block.time_iota_ms * 1_000_000
+        if self.rs.locked_block is not None:
+            min_vote_time = self.rs.locked_block.header.time_ns + time_iota_ns
+        elif self.rs.proposal_block is not None:
+            min_vote_time = self.rs.proposal_block.header.time_ns + time_iota_ns
+        return now if now > min_vote_time else min_vote_time
+
+    def _sign_vote(self, msg_type: SignedMsgType, hash_: bytes,
+                   header: PartSetHeader) -> Vote:
+        """(state.go:2172 signVote)"""
+        self.wal.flush_and_sync()
+        addr = self.priv_validator_pub_key.address()
+        val_idx, _ = self.rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=BlockID(hash_, header),
+            timestamp_ns=self._vote_time_ns(),
+            validator_address=addr,
+            validator_index=val_idx,
+        )
+        self.priv_validator.sign_vote(self.state.chain_id, vote)
+        return vote
+
+    def _sign_add_vote(self, msg_type: SignedMsgType, hash_: bytes,
+                       header: PartSetHeader) -> Optional[Vote]:
+        """(state.go:2227 signAddVote)"""
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            return None
+        if not self.rs.validators.has_address(self.priv_validator_pub_key.address()):
+            return None
+        try:
+            vote = self._sign_vote(msg_type, hash_, header)
+        except Exception as e:
+            if not self._replay_mode:
+                logger.error("failed signing vote height=%d round=%d: %s",
+                             self.rs.height, self.rs.round, e)
+            return None
+        self.send_internal(VoteMessage(vote))
+        return vote

@@ -1,0 +1,222 @@
+"""Consensus write-ahead log (reference consensus/wal.go:58).
+
+Every consensus input is logged before it acts on the state machine; own
+(internal) messages are fsynced. Framing mirrors the reference encoder
+(wal.go:288): crc32(payload) u32 BE || length u32 BE || payload. Payload is a
+JSON envelope {time_ns, type, data} — msg types: "vote", "proposal",
+"block_part", "timeout", "end_height", "round_step" (EventDataRoundStep).
+Size-rotated like libs/autofile.Group.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+from ..types.part_set import Part
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+MAX_MSG_SIZE_BYTES = 1024 * 1024  # 1MB (wal.go maxMsgSizeBytes)
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # autofile group head rotation
+DEFAULT_GROUP_LIMIT = 60 * 1024 * 1024
+
+
+@dataclass
+class TimeoutInfo:
+    duration_s: float
+    height: int
+    round: int
+    step: int  # RoundStep value
+
+
+@dataclass
+class EndHeightMessage:
+    height: int
+
+
+@dataclass
+class WALMessage:
+    time_ns: int
+    type: str
+    data: dict
+
+
+def _encode_vote(v: Vote) -> dict:
+    return {"vote": v.encode().hex()}
+
+
+def _encode_msg(msg, peer_id: str) -> Tuple[str, dict]:
+    from .state import BlockPartMessage, ProposalMessage, VoteMessage
+
+    if isinstance(msg, VoteMessage):
+        return "vote", {"vote": msg.vote.encode().hex(), "peer": peer_id}
+    if isinstance(msg, ProposalMessage):
+        return "proposal", {"proposal": msg.proposal.encode().hex(), "peer": peer_id}
+    if isinstance(msg, BlockPartMessage):
+        return "block_part", {"height": msg.height, "round": msg.round,
+                              "part": msg.part.encode().hex(), "peer": peer_id}
+    raise ValueError(f"unsupported WAL message {type(msg)}")
+
+
+class WAL:
+    def __init__(self, path: str, head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT):
+        self.path = path
+        self._head_size_limit = head_size_limit
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    # -- writing -----------------------------------------------------------
+
+    def _write_record(self, payload: bytes, sync: bool) -> None:
+        if len(payload) > MAX_MSG_SIZE_BYTES:
+            raise ValueError(f"msg is too big: {len(payload)} bytes, max: {MAX_MSG_SIZE_BYTES}")
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(struct.pack(">II", crc, len(payload)) + payload)
+        self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+        self._maybe_rotate()
+
+    def _maybe_rotate(self) -> None:
+        if self._f.tell() > self._head_size_limit:
+            self._f.close()
+            idx = 0
+            while os.path.exists(f"{self.path}.{idx}"):
+                idx += 1
+            os.rename(self.path, f"{self.path}.{idx}")
+            self._f = open(self.path, "ab")
+
+    def _envelope(self, type_: str, data: dict, time_ns: int) -> bytes:
+        return json.dumps({"time_ns": time_ns, "type": type_, "data": data},
+                          separators=(",", ":")).encode()
+
+    def write(self, type_: str, data: dict, time_ns: int = 0) -> None:
+        self._write_record(self._envelope(type_, data, time_ns), sync=False)
+
+    def write_sync(self, type_: str, data: dict, time_ns: int = 0) -> None:
+        self._write_record(self._envelope(type_, data, time_ns), sync=True)
+
+    def write_msg_info(self, msg, peer_id: str, time_ns: int, internal: bool) -> None:
+        """msgInfo records; fsync for our own messages (state.go:754,763)."""
+        type_, data = _encode_msg(msg, peer_id)
+        if internal:
+            self.write_sync(type_, data, time_ns)
+        else:
+            self.write(type_, data, time_ns)
+
+    def write_timeout(self, ti: TimeoutInfo, time_ns: int) -> None:
+        self.write("timeout", {"duration_s": ti.duration_s, "height": ti.height,
+                               "round": ti.round, "step": int(ti.step)}, time_ns)
+
+    def write_end_height(self, height: int, time_ns: int) -> None:
+        self.write_sync("end_height", {"height": height}, time_ns)
+
+    def write_round_step(self, height: int, round_: int, step: int, time_ns: int) -> None:
+        self.write("round_step", {"height": height, "round": round_, "step": step}, time_ns)
+
+    def flush_and_sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+            self._f.close()
+        except ValueError:
+            pass
+
+    # -- reading -----------------------------------------------------------
+
+    def _all_paths(self) -> List[str]:
+        """Rotated files oldest-first, then the head."""
+        idx = 0
+        out = []
+        while os.path.exists(f"{self.path}.{idx}"):
+            out.append(f"{self.path}.{idx}")
+            idx += 1
+        if os.path.exists(self.path):
+            out.append(self.path)
+        return out
+
+    def iter_messages(self) -> Iterator[WALMessage]:
+        """All decodable messages; stops cleanly at a torn/corrupt tail
+        (reference wal decoder DataCorruptionError tolerance)."""
+        for path in self._all_paths():
+            with open(path, "rb") as f:
+                raw = f.read()
+            pos = 0
+            while pos + 8 <= len(raw):
+                crc, ln = struct.unpack_from(">II", raw, pos)
+                if ln > MAX_MSG_SIZE_BYTES or pos + 8 + ln > len(raw):
+                    return  # torn write at tail
+                payload = raw[pos + 8:pos + 8 + ln]
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    return  # corruption: stop replay here
+                try:
+                    d = json.loads(payload.decode())
+                except (ValueError, UnicodeDecodeError):
+                    return
+                yield WALMessage(d.get("time_ns", 0), d["type"], d.get("data", {}))
+                pos += 8 + ln
+
+    def search_for_end_height(self, height: int) -> bool:
+        """True if #ENDHEIGHT for `height` exists (wal.go:231) — meaning the
+        block at `height` was fully committed and WAL replay should start
+        after that record."""
+        for m in self.iter_messages():
+            if m.type == "end_height" and m.data.get("height") == height:
+                return True
+        return False
+
+    def messages_after_end_height(self, height: int) -> List[WALMessage]:
+        """Messages following the #ENDHEIGHT record for `height`."""
+        out: List[WALMessage] = []
+        found = False
+        for m in self.iter_messages():
+            if found:
+                out.append(m)
+            elif m.type == "end_height" and m.data.get("height") == height:
+                found = True
+        return out
+
+
+class NilWAL(WAL):
+    """No-op WAL for tests (consensus/wal.go:421 nilWAL)."""
+
+    def __init__(self):  # noqa: super-init-not-called
+        pass
+
+    def _write_record(self, payload: bytes, sync: bool) -> None:
+        pass
+
+    def write(self, *a, **k) -> None:
+        pass
+
+    def write_sync(self, *a, **k) -> None:
+        pass
+
+    def write_msg_info(self, *a, **k) -> None:
+        pass
+
+    def write_timeout(self, *a, **k) -> None:
+        pass
+
+    def write_end_height(self, *a, **k) -> None:
+        pass
+
+    def write_round_step(self, *a, **k) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def iter_messages(self):
+        return iter(())

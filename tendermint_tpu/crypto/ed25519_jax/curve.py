@@ -218,10 +218,14 @@ def _build_base_table() -> np.ndarray:
 
 
 def base_table() -> jnp.ndarray:
+    # Cache holds a NUMPY array: caching a jnp array built inside a
+    # shard_map/jit trace leaks that trace's tracer into later jits
+    # (UnexpectedTracerError). jnp.asarray at the use site is free — XLA
+    # interns the constant per-compilation.
     global _BASE_TABLE_CACHE
     if _BASE_TABLE_CACHE is None:
-        _BASE_TABLE_CACHE = jnp.asarray(_build_base_table())
-    return _BASE_TABLE_CACHE
+        _BASE_TABLE_CACHE = _build_base_table()
+    return jnp.asarray(_BASE_TABLE_CACHE)
 
 
 def scalar_mul_base(digits: jnp.ndarray) -> Point:

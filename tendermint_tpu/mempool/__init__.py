@@ -1,0 +1,3 @@
+"""Mempool (reference mempool/, SURVEY.md §2.5)."""
+
+from .clist_mempool import CListMempool, MempoolError, TxCache  # noqa: F401

@@ -1,0 +1,238 @@
+"""FIFO mempool with async-style CheckTx and post-block recheck
+(reference mempool/v0/clist_mempool.go:26).
+
+The clist structure in the reference exists so per-peer gossip goroutines can
+block at the tail; here an ordered dict + per-peer cursor indexes give the
+same semantics for asyncio gossip tasks (see mempool reactor).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..abci import types as abci
+from ..abci.client import Client
+
+MAX_TX_CACHE = 10000
+
+
+class MempoolError(Exception):
+    pass
+
+
+class ErrTxInCache(MempoolError):
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int  # height when validated
+    gas_wanted: int
+    senders: Set[str]  # peers that sent us this tx (mempool/v0 memTx.senders)
+
+
+class TxCache:
+    """LRU of recently seen tx hashes (mempool/cache.go)."""
+
+    def __init__(self, size: int = MAX_TX_CACHE):
+        self._size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present."""
+        key = hashlib.sha256(tx).digest()
+        if key in self._map:
+            self._map.move_to_end(key)
+            return False
+        self._map[key] = None
+        if len(self._map) > self._size:
+            self._map.popitem(last=False)
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(hashlib.sha256(tx).digest(), None)
+
+    def reset(self) -> None:
+        self._map.clear()
+
+
+class CListMempool:
+    def __init__(self, proxy_app: Client, height: int = 0,
+                 max_txs: int = 5000, max_txs_bytes: int = 1073741824,
+                 max_tx_bytes: int = 1048576, cache_size: int = MAX_TX_CACHE,
+                 keep_invalid_txs_in_cache: bool = False,
+                 recheck: bool = True):
+        self._proxy_app = proxy_app
+        self._height = height
+        self._max_txs = max_txs
+        self._max_txs_bytes = max_txs_bytes
+        self._max_tx_bytes = max_tx_bytes
+        self._keep_invalid = keep_invalid_txs_in_cache
+        self._recheck_enabled = recheck
+        self.cache = TxCache(cache_size)
+        self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()  # key=sha256(tx)
+        self._txs_bytes = 0
+        self._mtx = threading.RLock()
+        self._notified_txs_available = False
+        self.tx_available_callbacks: List[Callable[[], None]] = []
+        self.pre_check: Optional[Callable[[bytes], None]] = None
+        self.post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], None]] = None
+
+    # -- Mempool interface (mempool/mempool.go:30) -------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._txs)
+
+    def tx_bytes(self) -> int:
+        with self._mtx:
+            return self._txs_bytes
+
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    def flush_app_conn(self) -> None:
+        self._proxy_app.flush()
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """Validate via app and add if OK (clist_mempool.go:203 CheckTx).
+
+        Synchronous analogue of the reference's async path: the response
+        callback logic (resCbFirstTime) runs inline.
+        """
+        with self._mtx:
+            if len(tx) > self._max_tx_bytes:
+                raise MempoolError(
+                    f"tx too large. Max size is {self._max_tx_bytes}, but got {len(tx)}")
+            if len(self._txs) >= self._max_txs or \
+                    self._txs_bytes + len(tx) > self._max_txs_bytes:
+                raise MempoolError(
+                    f"mempool is full: number of txs {len(self._txs)} "
+                    f"(max: {self._max_txs}), total bytes {self._txs_bytes}")
+            if self.pre_check is not None:
+                self.pre_check(tx)
+            if not self.cache.push(tx):
+                # record the new sender for an existing tx (clist_mempool.go:239)
+                key = hashlib.sha256(tx).digest()
+                existing = self._txs.get(key)
+                if existing is not None and sender:
+                    existing.senders.add(sender)
+                raise ErrTxInCache()
+
+            res = self._proxy_app.check_tx(abci.RequestCheckTx(tx=tx))
+            if self.post_check is not None:
+                self.post_check(tx, res)
+            if res.is_ok():
+                mem_tx = MempoolTx(tx, self._height, res.gas_wanted,
+                                   {sender} if sender else set())
+                self._txs[hashlib.sha256(tx).digest()] = mem_tx
+                self._txs_bytes += len(tx)
+                self._notify_txs_available()
+            else:
+                if not self._keep_invalid:
+                    self.cache.remove(tx)
+            return res
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """(clist_mempool.go:521)"""
+        with self._mtx:
+            total_bytes = 0
+            total_gas = 0
+            out: List[bytes] = []
+            for mem_tx in self._txs.values():
+                tx_size = len(mem_tx.tx) + _proto_overhead(len(mem_tx.tx))
+                if max_bytes > -1 and total_bytes + tx_size > max_bytes:
+                    break
+                new_gas = total_gas + mem_tx.gas_wanted
+                if max_gas > -1 and new_gas > max_gas:
+                    break
+                total_bytes += tx_size
+                total_gas = new_gas
+                out.append(mem_tx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._mtx:
+            txs = [m.tx for m in self._txs.values()]
+            return txs if n < 0 else txs[:n]
+
+    def update(self, height: int, txs: List[bytes],
+               deliver_tx_responses: List[abci.ResponseCheckTx],
+               pre_check=None, post_check=None) -> None:
+        """Remove committed txs, recheck the rest (clist_mempool.go:594).
+        Caller must hold the lock (BlockExecutor.commit does)."""
+        self._height = height
+        self._notified_txs_available = False
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
+        for tx, res in zip(txs, deliver_tx_responses):
+            if res.is_ok():
+                self.cache.push(tx)  # committed: keep in cache to block resubmission
+            elif not self._keep_invalid:
+                self.cache.remove(tx)
+            key = hashlib.sha256(tx).digest()
+            mem_tx = self._txs.pop(key, None)
+            if mem_tx is not None:
+                self._txs_bytes -= len(mem_tx.tx)
+        if self._txs and self._recheck_enabled:
+            self._recheck_txs()
+        if self._txs:
+            self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        """Re-run CheckTx on remaining txs post-block (clist_mempool.go:641)."""
+        for key in list(self._txs.keys()):
+            mem_tx = self._txs[key]
+            res = self._proxy_app.check_tx(abci.RequestCheckTx(
+                tx=mem_tx.tx, type=abci.CHECK_TX_TYPE_RECHECK))
+            if self.post_check is not None:
+                self.post_check(mem_tx.tx, res)
+            if not res.is_ok():
+                del self._txs[key]
+                self._txs_bytes -= len(mem_tx.tx)
+                if not self._keep_invalid:
+                    self.cache.remove(mem_tx.tx)
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._txs.clear()
+            self._txs_bytes = 0
+            self.cache.reset()
+
+    # -- gossip support ----------------------------------------------------
+
+    def entries_after(self, cursor: int) -> Tuple[List[MempoolTx], int]:
+        """Txs in insertion order after position `cursor`; returns new cursor.
+        A stable iteration surface for reactor gossip tasks."""
+        with self._mtx:
+            items = list(self._txs.values())
+        return items[cursor:], len(items)
+
+    def has_tx(self, tx: bytes) -> bool:
+        with self._mtx:
+            return hashlib.sha256(tx).digest() in self._txs
+
+    # -- txs-available notification (clist_mempool.go TxsAvailable) --------
+
+    def _notify_txs_available(self) -> None:
+        if not self._notified_txs_available and self._txs:
+            self._notified_txs_available = True
+            for cb in self.tx_available_callbacks:
+                cb()
+
+
+def _proto_overhead(n: int) -> int:
+    from ..types.tx import compute_proto_size_overhead
+
+    return compute_proto_size_overhead(n)

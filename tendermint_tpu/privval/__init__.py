@@ -1,0 +1,3 @@
+"""Validator key management (reference privval/, SURVEY.md §2.13)."""
+
+from .file_pv import FilePV, load_or_gen_file_pv  # noqa: F401

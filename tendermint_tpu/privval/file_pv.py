@@ -1,0 +1,267 @@
+"""FilePV: file-backed validator key with double-sign protection
+(reference privval/file.go:148).
+
+Persisted last-sign-state (H/R/Step + sign-bytes) forbids re-signing a
+different value at the same HRS; the only allowed re-sign is the same vote
+differing ONLY by timestamp (file.go:400 checkVotesOnlyDifferByTimestamp) —
+the remote-signer reconnect case.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from .. import crypto
+from ..libs import protowire as pw
+from ..types.basic import SignedMsgType
+from ..types.priv_validator import PrivValidator
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+STEP_NONE = 0
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+
+def vote_to_step(v: Vote) -> int:
+    if v.type == SignedMsgType.PREVOTE:
+        return STEP_PREVOTE
+    if v.type == SignedMsgType.PRECOMMIT:
+        return STEP_PRECOMMIT
+    raise ValueError(f"unknown vote type: {v.type}")
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+@dataclass
+class LastSignState:
+    """(file.go:75 FilePVLastSignState)"""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NONE
+    signature: bytes = b""
+    sign_bytes: bytes = b""
+    file_path: str = ""
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """Returns True if HRS matches exactly and a signature exists
+        (file.go:92 CheckHRS). Raises on regression."""
+        if self.height > height:
+            raise DoubleSignError(f"height regression. Got {height}, last height {self.height}")
+        if self.height == height:
+            if self.round > round_:
+                raise DoubleSignError(
+                    f"round regression at height {height}. Got {round_}, last round {self.round}")
+            if self.round == round_:
+                if self.step > step:
+                    raise DoubleSignError(
+                        f"step regression at height {height} round {round_}. "
+                        f"Got {step}, last step {self.step}")
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise DoubleSignError("no SignBytes found")
+                    if not self.signature:
+                        raise RuntimeError("pv: Signature is nil but SignBytes is not!")
+                    return True
+        return False
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        data = json.dumps({
+            "height": self.height, "round": self.round, "step": self.step,
+            "signature": self.signature.hex(), "signbytes": self.sign_bytes.hex(),
+        }, indent=2)
+        _atomic_write(self.file_path, data)
+
+    @staticmethod
+    def load(path: str) -> "LastSignState":
+        with open(path) as f:
+            d = json.load(f)
+        return LastSignState(
+            height=d.get("height", 0), round=d.get("round", 0),
+            step=d.get("step", STEP_NONE),
+            signature=bytes.fromhex(d.get("signature", "")),
+            sign_bytes=bytes.fromhex(d.get("signbytes", "")),
+            file_path=path,
+        )
+
+
+def _atomic_write(path: str, data: str) -> None:
+    """(libs/tempfile atomic write)"""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class FilePV(PrivValidator):
+    def __init__(self, priv_key: crypto.PrivKey, key_file_path: str = "",
+                 state_file_path: str = ""):
+        self.priv_key = priv_key
+        self.key_file_path = key_file_path
+        self.last_sign_state = LastSignState(file_path=state_file_path)
+
+    # -- persistence -------------------------------------------------------
+
+    @staticmethod
+    def generate(key_file_path: str = "", state_file_path: str = "",
+                 seed: Optional[bytes] = None) -> "FilePV":
+        pv = FilePV(crypto.Ed25519PrivKey.generate(seed), key_file_path, state_file_path)
+        return pv
+
+    def save(self) -> None:
+        if self.key_file_path:
+            pub = self.priv_key.pub_key()
+            data = json.dumps({
+                "address": pub.address().hex().upper(),
+                "pub_key": {"type": pub.type_name, "value": pub.bytes().hex()},
+                "priv_key": {"type": self.priv_key.type_name,
+                             "value": self.priv_key.bytes().hex()},
+            }, indent=2)
+            _atomic_write(self.key_file_path, data)
+        self.last_sign_state.save()
+
+    @staticmethod
+    def load(key_file_path: str, state_file_path: str) -> "FilePV":
+        with open(key_file_path) as f:
+            d = json.load(f)
+        priv = crypto.Ed25519PrivKey(bytes.fromhex(d["priv_key"]["value"]))
+        pv = FilePV(priv, key_file_path, state_file_path)
+        if os.path.exists(state_file_path):
+            pv.last_sign_state = LastSignState.load(state_file_path)
+        else:
+            pv.last_sign_state = LastSignState(file_path=state_file_path)
+        return pv
+
+    # -- PrivValidator -----------------------------------------------------
+
+    def get_pub_key(self) -> crypto.PubKey:
+        return self.priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        """(file.go:303 signVote)"""
+        height, round_, step = vote.height, vote.round, vote_to_step(vote)
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = vote.sign_bytes(chain_id)
+
+        if same_hrs:
+            # Only timestamp may differ (file.go:330-343)
+            if lss.sign_bytes == sign_bytes:
+                vote.signature = lss.signature
+                return
+            ts, ok = _votes_only_differ_by_timestamp(lss.sign_bytes, sign_bytes)
+            if ok:
+                vote.timestamp_ns = ts
+                vote.signature = lss.signature
+                return
+            raise DoubleSignError("conflicting data")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        vote.signature = sig
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        """(file.go:356 signProposal)"""
+        height, round_, step = proposal.height, proposal.round, STEP_PROPOSE
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = proposal.sign_bytes(chain_id)
+
+        if same_hrs:
+            if lss.sign_bytes == sign_bytes:
+                proposal.signature = lss.signature
+                return
+            ts, ok = _proposals_only_differ_by_timestamp(lss.sign_bytes, sign_bytes)
+            if ok:
+                proposal.timestamp_ns = ts
+                proposal.signature = lss.signature
+                return
+            raise DoubleSignError("conflicting data")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        proposal.signature = sig
+
+    def _save_signed(self, height: int, round_: int, step: int,
+                     sign_bytes: bytes, sig: bytes) -> None:
+        lss = self.last_sign_state
+        lss.height, lss.round, lss.step = height, round_, step
+        lss.signature = sig
+        lss.sign_bytes = sign_bytes
+        lss.save()
+
+
+def _strip_timestamp_vote(sign_bytes: bytes) -> Tuple[bytes, int]:
+    """Canonical vote sign-bytes with the timestamp field (5) zeroed; returns
+    (stripped encoding, timestamp_ns) — file.go:400 semantics."""
+    body, _ = pw.read_length_delimited(sign_bytes)
+    w = pw.Writer()
+    ts = 0
+    for fn, wt, v in pw.iter_fields(body):
+        if fn == 5 and wt == pw.WIRE_BYTES:
+            ts = pw.parse_timestamp(v)
+            continue
+        _rewrite_field(w, fn, wt, v)
+    return w.finish(), ts
+
+
+def _strip_timestamp_proposal(sign_bytes: bytes) -> Tuple[bytes, int]:
+    body, _ = pw.read_length_delimited(sign_bytes)
+    w = pw.Writer()
+    ts = 0
+    for fn, wt, v in pw.iter_fields(body):
+        if fn == 6 and wt == pw.WIRE_BYTES:
+            ts = pw.parse_timestamp(v)
+            continue
+        _rewrite_field(w, fn, wt, v)
+    return w.finish(), ts
+
+
+def _rewrite_field(w: pw.Writer, fn: int, wt: int, v) -> None:
+    if wt == pw.WIRE_VARINT:
+        w._buf += pw.tag(fn, wt) + pw.encode_varint(v)
+    elif wt == pw.WIRE_FIXED64:
+        w._buf += pw.tag(fn, wt) + v.to_bytes(8, "little")
+    elif wt == pw.WIRE_BYTES:
+        w._buf += pw.tag(fn, wt) + pw.encode_varint(len(v)) + v
+    else:
+        raise ValueError(f"unsupported wire type {wt}")
+
+
+def _votes_only_differ_by_timestamp(last: bytes, new: bytes) -> Tuple[int, bool]:
+    last_stripped, last_ts = _strip_timestamp_vote(last)
+    new_stripped, _ = _strip_timestamp_vote(new)
+    return last_ts, last_stripped == new_stripped
+
+
+def _proposals_only_differ_by_timestamp(last: bytes, new: bytes) -> Tuple[int, bool]:
+    last_stripped, last_ts = _strip_timestamp_proposal(last)
+    new_stripped, _ = _strip_timestamp_proposal(new)
+    return last_ts, last_stripped == new_stripped
+
+
+def load_or_gen_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
+    """(file.go LoadOrGenFilePV)"""
+    if os.path.exists(key_file_path):
+        return FilePV.load(key_file_path, state_file_path)
+    pv = FilePV.generate(key_file_path, state_file_path)
+    pv.save()
+    return pv

@@ -53,7 +53,8 @@ class PartSetHeader:
         total, h = 0, b""
         for fn, _wt, v in pw.iter_fields(data):
             if fn == 1:
-                total = v
+                total = v & 0xFFFFFFFF  # uint32 on the wire; don't let an
+                # oversized varint crash key() downstream
             elif fn == 2:
                 h = v
         return PartSetHeader(total, h)

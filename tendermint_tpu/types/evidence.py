@@ -32,7 +32,13 @@ class Evidence:
         raise NotImplementedError
 
     def bytes(self) -> bytes:
-        """Proto encoding of the Evidence oneof wrapper."""
+        """UNWRAPPED proto encoding (reference Bytes() = ToProto().Marshal(),
+        evidence.go:90-98 — no oneof envelope). This is what EvidenceList.Hash
+        and Evidence.Hash consume."""
+        raise NotImplementedError
+
+    def wrapped(self) -> bytes:
+        """Evidence oneof envelope, for the EvidenceList wire message."""
         raise NotImplementedError
 
     def hash(self) -> bytes:
@@ -79,7 +85,7 @@ class DuplicateVoteEvidence(Evidence):
     def time_ns(self) -> int:
         return self.timestamp_ns
 
-    def _body(self) -> bytes:
+    def bytes(self) -> bytes:
         w = pw.Writer()
         w.message(1, self.vote_a.encode())
         w.message(2, self.vote_b.encode())
@@ -88,9 +94,9 @@ class DuplicateVoteEvidence(Evidence):
         w.message(5, pw.timestamp(self.timestamp_ns))
         return w.finish()
 
-    def bytes(self) -> bytes:
+    def wrapped(self) -> bytes:
         w = pw.Writer()
-        w.message(1, self._body())  # oneof sum: field 1
+        w.message(1, self.bytes())  # oneof sum: field 1
         return w.finish()
 
     def hash(self) -> bytes:
@@ -154,7 +160,7 @@ class LightClientAttackEvidence(Evidence):
         bz[32:] = varint
         return hashlib.sha256(bytes(bz)).digest()
 
-    def _body(self) -> bytes:
+    def bytes(self) -> bytes:
         w = pw.Writer()
         w.message(1, self.conflicting_block.encode())
         w.varint(2, self.common_height)
@@ -164,9 +170,9 @@ class LightClientAttackEvidence(Evidence):
         w.message(5, pw.timestamp(self.timestamp_ns))
         return w.finish()
 
-    def bytes(self) -> bytes:
+    def wrapped(self) -> bytes:
         w = pw.Writer()
-        w.message(2, self._body())  # oneof sum: field 2
+        w.message(2, self.bytes())  # oneof sum: field 2
         return w.finish()
 
     def validate_basic(self) -> None:
@@ -186,10 +192,10 @@ def evidence_list_hash(evidence: List[Evidence]) -> bytes:
 
 
 def encode_evidence_list(evidence: List[Evidence]) -> bytes:
-    """EvidenceList proto message (evidence.proto:37)."""
+    """EvidenceList proto message (evidence.proto:37) — oneof-wrapped items."""
     w = pw.Writer()
     for ev in evidence:
-        w.message(1, ev.bytes())
+        w.message(1, ev.wrapped())
     return w.finish()
 
 

@@ -1,10 +1,16 @@
 """Test env: force JAX onto CPU with 8 virtual devices so sharding/multi-chip
 paths are exercised without TPU hardware (the driver benches on the real chip).
-Must run before any jax import."""
+
+Must run before any jax import. The image's sitecustomize registers the axon
+TPU backend whenever PALLAS_AXON_POOL_IPS is set and the environment pins
+JAX_PLATFORMS=axon — both must be overridden (not setdefault'ed) or the whole
+suite silently runs on the real chip through the remote-compile relay.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon backend registration
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

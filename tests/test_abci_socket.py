@@ -1,0 +1,83 @@
+"""Out-of-process ABCI: socket server/client round-trips incl. Header transport."""
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import SocketClient
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.abci.server import ABCIServer
+from tendermint_tpu.types.block import Consensus, Header
+
+
+@pytest.fixture
+def server_client(tmp_path):
+    app = KVStoreApplication()
+    srv = ABCIServer(f"unix://{tmp_path}/abci.sock", app)
+    srv.start()
+    client = SocketClient(f"unix://{tmp_path}/abci.sock")
+    yield app, client
+    client.close()
+    srv.stop()
+
+
+def test_echo_info(server_client):
+    app, client = server_client
+    assert client.echo("ping") == "ping"
+    info = client.info(abci.RequestInfo(version="x"))
+    assert info.last_block_height == 0
+
+
+def test_deliver_and_commit(server_client):
+    app, client = server_client
+    res = client.deliver_tx(abci.RequestDeliverTx(tx=b"sock=et"))
+    assert res.is_ok()
+    assert res.events and res.events[0].type == "app"
+    assert isinstance(res.events[0].attributes[0], abci.EventAttribute)
+    commit = client.commit()
+    assert commit.data == (1).to_bytes(8, "big")
+    assert app.state["sock"] == "et"
+
+
+def test_begin_block_header_crosses_socket(server_client):
+    app, client = server_client
+
+    seen = {}
+    orig = app.begin_block
+
+    def spy(req):
+        seen["header"] = req.header
+        return orig(req)
+
+    app.begin_block = spy
+    header = Header(version=Consensus(11, 0), chain_id="sock-chain", height=9,
+                    validators_hash=b"\x01" * 32, proposer_address=b"\x02" * 20)
+    client.begin_block(abci.RequestBeginBlock(
+        hash=b"\x03" * 32, header=header,
+        last_commit_info=abci.LastCommitInfo(round=1, votes=[
+            abci.VoteInfo(abci.ABCIValidator(b"\x04" * 20, 10), True)])))
+    got = seen["header"]
+    assert isinstance(got, Header)
+    assert got.chain_id == "sock-chain" and got.height == 9
+    assert got.validators_hash == header.validators_hash
+
+
+def test_query_roundtrip(server_client):
+    app, client = server_client
+    client.deliver_tx(abci.RequestDeliverTx(tx=b"k=v"))
+    res = client.query(abci.RequestQuery(data=b"k", path="/store"))
+    assert res.value == b"v" and res.log == "exists"
+
+
+def test_error_reported_not_fatal(server_client):
+    app, client = server_client
+
+    def boom(req):
+        raise RuntimeError("kaboom")
+
+    app.query = boom
+    from tendermint_tpu.abci.client import ABCIClientError
+
+    with pytest.raises(ABCIClientError, match="kaboom"):
+        client.query(abci.RequestQuery(data=b"k"))
+    # connection still usable
+    assert client.echo("still-alive") == "still-alive"

@@ -1,0 +1,159 @@
+"""Single-validator consensus: the state machine must produce blocks over the
+kvstore app end-to-end (SURVEY.md §7 stage 5 definition-of-done), and recover
+across restart via WAL + handshake replay.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.consensus import ConsensusState, WAL
+from tendermint_tpu.consensus.config import test_consensus_config
+from tendermint_tpu.consensus.replay import Handshaker, catchup_replay
+from tendermint_tpu.libs.db import MemDB, SQLiteDB
+from tendermint_tpu.mempool import CListMempool
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis
+from tendermint_tpu.state.execution import EmptyEvidencePool
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types import events as tme
+
+CHAIN_ID = "single-chain"
+
+
+def build_node(tmp_path=None, app=None, pv=None, db_factory=MemDB, wal=None):
+    pv = pv or MockPV(crypto.Ed25519PrivKey.generate(b"\x33" * 32))
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)],
+    )
+    app = app or KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    state_store = StateStore(db_factory())
+    block_store = BlockStore(db_factory())
+    state = state_from_genesis(genesis)
+
+    handshaker = Handshaker(state_store, state, block_store, genesis)
+    state = handshaker.handshake(conns.consensus, conns.query)
+    state_store.save(state)
+
+    mempool = CListMempool(conns.mempool)
+    event_bus = EventBus()
+    block_exec = BlockExecutor(state_store, conns.consensus, mempool,
+                               EmptyEvidencePool(), block_store, event_bus)
+    cs = ConsensusState(test_consensus_config(), state, block_exec, block_store,
+                        wal=wal)
+    cs.set_priv_validator(pv)
+    cs.set_event_bus(event_bus)
+    mempool.tx_available_callbacks.append(cs.notify_txs_available)
+    return cs, mempool, app, event_bus, pv, (state_store, block_store, genesis, conns)
+
+
+async def wait_for_height(event_bus: EventBus, cs: ConsensusState, height: int,
+                          timeout: float = 10.0):
+    sub = event_bus.subscribe(f"test-wait-{height}", tme.QUERY_NEW_BLOCK)
+    try:
+        while True:
+            msg = await asyncio.wait_for(sub.next(), timeout)
+            if msg.data.block.header.height >= height:
+                return
+    finally:
+        event_bus.unsubscribe_all(f"test-wait-{height}")
+
+
+def test_single_validator_produces_blocks():
+    async def run():
+        cs, mempool, app, event_bus, pv, _ = build_node()
+        await cs.start()
+        try:
+            mempool.check_tx(b"alpha=1")
+            await wait_for_height(event_bus, cs, 3)
+        finally:
+            await cs.stop()
+        assert cs.state.last_block_height >= 3
+        assert app.state.get("alpha") == "1"
+        # the tx was committed and removed from mempool
+        assert mempool.size() == 0
+
+    asyncio.run(run())
+
+
+def test_single_validator_commits_txs_across_heights():
+    async def run():
+        cs, mempool, app, event_bus, pv, _ = build_node()
+        await cs.start()
+        try:
+            mempool.check_tx(b"k1=a")
+            await wait_for_height(event_bus, cs, 1)
+            mempool.check_tx(b"k2=b")
+            mempool.check_tx(b"k3=c")
+            await wait_for_height(event_bus, cs, cs.state.last_block_height + 2)
+        finally:
+            await cs.stop()
+        assert app.state == {"k1": "a", "k2": "b", "k3": "c"}
+
+    asyncio.run(run())
+
+
+def test_wal_written_and_replayable(tmp_path):
+    async def run():
+        wal = WAL(str(tmp_path / "cs.wal"))
+        cs, mempool, app, event_bus, pv, _ = build_node(wal=wal)
+        await cs.start()
+        try:
+            mempool.check_tx(b"x=y")
+            await wait_for_height(event_bus, cs, 2)
+        finally:
+            await cs.stop()
+        committed = cs.state.last_block_height
+        # WAL has end-height records for every committed height
+        wal2 = WAL(str(tmp_path / "cs.wal"))
+        for h in range(1, committed + 1):
+            assert wal2.search_for_end_height(h), f"missing ENDHEIGHT {h}"
+        # and messages after the last end-height replay into a fresh machine
+        msgs = wal2.messages_after_end_height(committed)
+        assert isinstance(msgs, list)
+
+    asyncio.run(run())
+
+
+def test_restart_recovers_via_handshake(tmp_path):
+    async def run():
+        dbs = {}
+
+        def db_factory(name_counter=[0]):
+            # stable SQLite files so the "restart" sees the same data
+            idx = name_counter[0]
+            name_counter[0] += 1
+            path = str(tmp_path / f"db{idx}.db")
+            db = SQLiteDB(path)
+            return db
+
+        pv = MockPV(crypto.Ed25519PrivKey.generate(b"\x44" * 32))
+        cs, mempool, app, event_bus, _, extras = build_node(pv=pv, db_factory=db_factory)
+        await cs.start()
+        mempool.check_tx(b"persist=me")
+        await wait_for_height(event_bus, cs, 2)
+        await cs.stop()
+        committed = cs.state.last_block_height
+        state_store, block_store, genesis, conns = extras
+
+        # "restart": fresh app at height 0, same stores → handshake replays
+        app2 = KVStoreApplication()
+        conns2 = AppConns(local_client_creator(app2))
+        conns2.start()
+        prev_state = state_store.load()
+        handshaker = Handshaker(state_store, prev_state, block_store, genesis)
+        state2 = handshaker.handshake(conns2.consensus, conns2.query)
+        assert handshaker.n_blocks == committed  # replayed every block
+        assert app2.height == committed
+        assert app2.state.get("persist") == "me"
+        assert state2.last_block_height == committed
+
+    asyncio.run(run())

@@ -1,0 +1,219 @@
+"""WAL framing/corruption, FilePV double-sign protection, mempool semantics."""
+
+import os
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.consensus.wal import WAL, TimeoutInfo
+from tendermint_tpu.mempool import CListMempool
+from tendermint_tpu.mempool.clist_mempool import ErrTxInCache, MempoolError
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.privval.file_pv import DoubleSignError
+from tendermint_tpu.types import BlockID, PartSetHeader, SignedMsgType, Vote
+from tendermint_tpu.types.proposal import Proposal
+
+BID = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+OTHER = BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32))
+
+
+# --- WAL -------------------------------------------------------------------
+
+class TestWAL:
+    def test_roundtrip(self, tmp_path):
+        wal = WAL(str(tmp_path / "w.wal"))
+        wal.write("round_step", {"height": 1, "round": 0, "step": 1}, 123)
+        wal.write_timeout(TimeoutInfo(1.5, 1, 0, 3), 124)
+        wal.write_end_height(1, 125)
+        wal.close()
+        msgs = list(WAL(str(tmp_path / "w.wal")).iter_messages())
+        assert [m.type for m in msgs] == ["round_step", "timeout", "end_height"]
+        assert msgs[1].data["duration_s"] == 1.5
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        wal.write("round_step", {"height": 1}, 1)
+        wal.write("round_step", {"height": 2}, 2)
+        wal.close()
+        with open(path, "ab") as f:
+            f.write(b"\x00\x01\x02")  # torn write
+        msgs = list(WAL(path).iter_messages())
+        assert len(msgs) == 2
+
+    def test_corrupt_crc_stops_replay(self, tmp_path):
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        wal.write("round_step", {"height": 1}, 1)
+        wal.write("round_step", {"height": 2}, 2)
+        wal.close()
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF  # corrupt last record's payload
+        open(path, "wb").write(bytes(raw))
+        msgs = list(WAL(path).iter_messages())
+        assert len(msgs) == 1
+
+    def test_search_for_end_height(self, tmp_path):
+        wal = WAL(str(tmp_path / "w.wal"))
+        wal.write_end_height(5, 1)
+        wal.write("vote", {"vote": "00", "peer": "p"}, 2)
+        assert wal.search_for_end_height(5)
+        assert not wal.search_for_end_height(6)
+        after = wal.messages_after_end_height(5)
+        assert len(after) == 1 and after[0].type == "vote"
+
+    def test_rotation(self, tmp_path):
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path, head_size_limit=512)
+        for i in range(100):
+            wal.write("round_step", {"height": i, "pad": "x" * 50}, i)
+        wal.close()
+        assert os.path.exists(path + ".0")  # rotated
+        msgs = list(WAL(path).iter_messages())
+        assert len(msgs) == 100  # reads across rotated files
+        assert [m.data["height"] for m in msgs] == list(range(100))
+
+
+# --- FilePV ----------------------------------------------------------------
+
+def mk_vote(h, r, t=SignedMsgType.PREVOTE, bid=BID, ts=1_700_000_000_000_000_000):
+    return Vote(t, h, r, bid, ts, b"\xaa" * 20, 0)
+
+
+class TestFilePV:
+    def test_sign_and_persist(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"),
+                             seed=b"\x01" * 32)
+        pv.save()
+        v = mk_vote(1, 0)
+        pv.sign_vote("chain", v)
+        assert v.signature
+        pv2 = FilePV.load(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+        assert pv2.get_pub_key() == pv.get_pub_key()
+        assert pv2.last_sign_state.height == 1
+
+    def test_double_sign_blocked(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"),
+                             seed=b"\x02" * 32)
+        v1 = mk_vote(5, 0, bid=BID)
+        pv.sign_vote("chain", v1)
+        v2 = mk_vote(5, 0, bid=OTHER)
+        with pytest.raises(DoubleSignError, match="conflicting data"):
+            pv.sign_vote("chain", v2)
+
+    def test_height_regression_blocked(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"),
+                             seed=b"\x03" * 32)
+        pv.sign_vote("chain", mk_vote(5, 0))
+        with pytest.raises(DoubleSignError, match="height regression"):
+            pv.sign_vote("chain", mk_vote(4, 0))
+
+    def test_same_vote_differs_only_by_timestamp_resigned(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"),
+                             seed=b"\x04" * 32)
+        v1 = mk_vote(5, 0, ts=1_700_000_000_000_000_000)
+        pv.sign_vote("chain", v1)
+        v2 = mk_vote(5, 0, ts=1_700_000_000_999_999_999)
+        pv.sign_vote("chain", v2)  # allowed: only timestamp differs
+        assert v2.signature == v1.signature
+        assert v2.timestamp_ns == v1.timestamp_ns  # original timestamp restored
+
+    def test_proposal_double_sign_blocked(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"),
+                             seed=b"\x05" * 32)
+        p1 = Proposal(7, 0, -1, BID, 1_700_000_000_000_000_000)
+        pv.sign_proposal("chain", p1)
+        p2 = Proposal(7, 0, -1, OTHER, 1_700_000_000_000_000_000)
+        with pytest.raises(DoubleSignError):
+            pv.sign_proposal("chain", p2)
+
+    def test_step_progression_allowed(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"),
+                             seed=b"\x06" * 32)
+        pv.sign_proposal("chain", Proposal(7, 0, -1, BID, 1))
+        pv.sign_vote("chain", mk_vote(7, 0, SignedMsgType.PREVOTE))
+        pv.sign_vote("chain", mk_vote(7, 0, SignedMsgType.PRECOMMIT))
+        pv.sign_vote("chain", mk_vote(8, 0, SignedMsgType.PREVOTE))
+
+
+# --- mempool ---------------------------------------------------------------
+
+class TestMempool:
+    def _mk(self, **kw):
+        app = KVStoreApplication()
+        return CListMempool(LocalClient(app), **kw), app
+
+    def test_check_and_reap(self):
+        mp, _ = self._mk()
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        assert mp.size() == 2
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [b"a=1", b"b=2"]
+        # byte-limited reap
+        assert mp.reap_max_bytes_max_gas(len(b"a=1") + 5, -1) == [b"a=1"]
+        # gas-limited reap (kvstore wants 1 gas per tx)
+        assert mp.reap_max_txs(1) == [b"a=1"]
+        assert mp.reap_max_bytes_max_gas(-1, 1) == [b"a=1"]
+
+    def test_duplicate_rejected_by_cache(self):
+        mp, _ = self._mk()
+        mp.check_tx(b"a=1")
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"a=1")
+
+    def test_update_removes_committed(self):
+        mp, _ = self._mk()
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        mp.lock()
+        try:
+            mp.update(1, [b"a=1"], [abci.ResponseCheckTx(code=0)])
+        finally:
+            mp.unlock()
+        assert mp.size() == 1
+        assert mp.reap_max_txs(-1) == [b"b=2"]
+        # committed tx stays cached: resubmission rejected
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"a=1")
+
+    def test_invalid_tx_not_added(self):
+        mp, _ = self._mk()
+        res = mp.check_tx(b"val:zz!bad")  # malformed validator tx
+        assert not res.is_ok()
+        assert mp.size() == 0
+        # and not cached (can retry)
+        res2 = mp.check_tx(b"val:zz!bad")
+        assert not res2.is_ok()
+
+    def test_full_mempool_errors(self):
+        mp, _ = self._mk(max_txs=2)
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        with pytest.raises(MempoolError, match="mempool is full"):
+            mp.check_tx(b"c=3")
+
+    def test_txs_available_notification(self):
+        mp, _ = self._mk()
+        fired = []
+        mp.tx_available_callbacks.append(lambda: fired.append(1))
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        assert fired == [1]  # only once until reset by update
+        mp.lock()
+        try:
+            mp.update(1, [b"a=1"], [abci.ResponseCheckTx(code=0)])
+        finally:
+            mp.unlock()
+        assert fired == [1, 1]  # remaining tx re-fires
+
+    def test_sender_tracking(self):
+        mp, _ = self._mk()
+        mp.check_tx(b"a=1", sender="peer1")
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"a=1", sender="peer2")
+        entries, cursor = mp.entries_after(0)
+        assert entries[0].senders == {"peer1", "peer2"}
+        assert cursor == 1
