@@ -35,6 +35,7 @@ class MempoolTx:
     height: int  # height when validated
     gas_wanted: int
     senders: Set[str]  # peers that sent us this tx (mempool/v0 memTx.senders)
+    key: bytes = b""  # sha256(tx), precomputed for gossip bookkeeping
 
 
 class TxCache:
@@ -132,9 +133,10 @@ class CListMempool:
             if self.post_check is not None:
                 self.post_check(tx, res)
             if res.is_ok():
+                key = hashlib.sha256(tx).digest()
                 mem_tx = MempoolTx(tx, self._height, res.gas_wanted,
-                                   {sender} if sender else set())
-                self._txs[hashlib.sha256(tx).digest()] = mem_tx
+                                   {sender} if sender else set(), key)
+                self._txs[key] = mem_tx
                 self._txs_bytes += len(tx)
                 self._notify_txs_available()
             else:

@@ -1,0 +1,99 @@
+"""In-process transport: whole multi-node networks in one asyncio loop
+(the reference's p2p test utilities — MakeConnectedSwitches over net.Pipe,
+p2p/test_util.go). The production TCP transport shares the Peer surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from .base import Peer
+from .switch import Switch
+
+logger = logging.getLogger("tmtpu.p2p.inproc")
+
+
+class InProcPeer(Peer):
+    """One side of a connected pair; sends enqueue into the remote's pump."""
+
+    def __init__(self, peer_id: str, outbound: bool):
+        super().__init__(peer_id, outbound)
+        self._remote: Optional["InProcPeer"] = None
+        self._recv_queue: "asyncio.Queue[Tuple[int, bytes]]" = asyncio.Queue(maxsize=10000)
+        self._running = True
+        self._pump_task: Optional[asyncio.Task] = None
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self.try_send(channel_id, msg)
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        if not self._running or self._remote is None:
+            return False
+        try:
+            self._remote._recv_queue.put_nowait((channel_id, msg))
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def is_running(self) -> bool:
+        return self._running
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except asyncio.CancelledError:
+                pass
+
+    async def _pump(self, switch: Switch) -> None:
+        """Deliver inbound messages into the owning switch."""
+        while self._running:
+            channel_id, msg = await self._recv_queue.get()
+            await switch.dispatch(channel_id, self, msg)
+            await asyncio.sleep(0)  # fairness under sustained load
+
+
+class InProcNetwork:
+    """Registry + wiring of in-proc switches (MakeConnectedSwitches analog)."""
+
+    def __init__(self):
+        self.switches: Dict[str, Switch] = {}
+
+    def add_switch(self, switch: Switch) -> None:
+        self.switches[switch.node_id] = switch
+
+    async def connect(self, id_a: str, id_b: str) -> None:
+        """Create a bidirectional pair and register with both switches."""
+        sw_a, sw_b = self.switches[id_a], self.switches[id_b]
+        peer_of_b = InProcPeer(id_b, outbound=True)   # a's view of b
+        peer_of_a = InProcPeer(id_a, outbound=False)  # b's view of a
+        peer_of_b._remote = peer_of_a
+        peer_of_a._remote = peer_of_b
+        peer_of_b._pump_task = asyncio.create_task(peer_of_b._pump(sw_a))
+        peer_of_a._pump_task = asyncio.create_task(peer_of_a._pump(sw_b))
+        await sw_a.add_peer(peer_of_b)
+        await sw_b.add_peer(peer_of_a)
+
+    async def connect_all(self) -> None:
+        ids = list(self.switches)
+        for i, a in enumerate(ids):
+            for b in ids[i + 1:]:
+                await self.connect(a, b)
+
+    async def disconnect(self, id_a: str, id_b: str) -> None:
+        """Sever the pair in both directions (perturbation support)."""
+        sw_a, sw_b = self.switches[id_a], self.switches[id_b]
+        pa = sw_a.peers.get(id_b)
+        pb = sw_b.peers.get(id_a)
+        if pa is not None:
+            await sw_a.stop_peer_gracefully(pa)
+        if pb is not None:
+            await sw_b.stop_peer_gracefully(pb)
+
+    async def stop(self) -> None:
+        for sw in self.switches.values():
+            await sw.stop()

@@ -1,0 +1,101 @@
+"""Switch: reactor registry + peer lifecycle (reference p2p/switch.go:69)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from .base import ChannelDescriptor, Peer, Reactor
+
+logger = logging.getLogger("tmtpu.p2p")
+
+
+class Switch:
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.reactors: Dict[str, Reactor] = {}
+        self._reactors_by_ch: Dict[int, Reactor] = {}
+        self.peers: Dict[str, Peer] = {}
+        self._running = False
+
+    # -- reactors (switch.go:163 AddReactor) -------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for ch in reactor.get_channels():
+            if ch.id in self._reactors_by_ch:
+                raise ValueError(
+                    f"channel {ch.id:#x} already registered by "
+                    f"{self._reactors_by_ch[ch.id].name}")
+            self._reactors_by_ch[ch.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Optional[Reactor]:
+        return self.reactors.get(name)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        for reactor in self.reactors.values():
+            await reactor.start()
+
+    async def stop(self) -> None:
+        self._running = False
+        for peer in list(self.peers.values()):
+            await self.stop_peer_gracefully(peer)
+        for reactor in self.reactors.values():
+            await reactor.stop()
+
+    # -- peers -------------------------------------------------------------
+
+    async def add_peer(self, peer: Peer) -> None:
+        """(switch.go:684 addPeer)"""
+        for reactor in self.reactors.values():
+            peer = reactor.init_peer(peer)
+        self.peers[peer.id] = peer
+        for reactor in self.reactors.values():
+            await reactor.add_peer(peer)
+        logger.debug("%s: added peer %s (%d total)", self.node_id[:8], peer.id[:8],
+                     len(self.peers))
+
+    async def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
+        """(switch.go:367)"""
+        logger.info("%s: stopping peer %s for error: %s", self.node_id[:8],
+                    peer.id[:8], reason)
+        await self._stop_and_remove_peer(peer, reason)
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._stop_and_remove_peer(peer, "graceful stop")
+
+    async def _stop_and_remove_peer(self, peer: Peer, reason: str) -> None:
+        if peer.id not in self.peers:
+            return
+        del self.peers[peer.id]
+        await peer.stop()
+        for reactor in self.reactors.values():
+            await reactor.remove_peer(peer, reason)
+
+    def num_peers(self) -> int:
+        return len(self.peers)
+
+    # -- broadcast (switch.go:272) -----------------------------------------
+
+    def broadcast(self, channel_id: int, msg: bytes) -> None:
+        for peer in list(self.peers.values()):
+            peer.try_send(channel_id, msg)
+
+    # -- inbound dispatch (called by transports) ---------------------------
+
+    async def dispatch(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        reactor = self._reactors_by_ch.get(channel_id)
+        if reactor is None:
+            logger.warning("no reactor for channel %#x", channel_id)
+            return
+        try:
+            await reactor.receive(channel_id, peer, msg_bytes)
+        except Exception as e:
+            logger.exception("reactor %s receive error from %s", reactor.name, peer.id[:8])
+            await self.stop_peer_for_error(peer, str(e))

@@ -1,0 +1,187 @@
+"""In-process multi-validator consensus networks (the reference's key test
+trick, consensus/common_test.go + reactor_test.go: N real state machines over
+a mock transport, no TCP).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.consensus import ConsensusState
+from tendermint_tpu.consensus.config import test_consensus_config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.mempool import CListMempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p import InProcNetwork, Switch
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis
+from tendermint_tpu.state.execution import EmptyEvidencePool
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types import events as tme
+
+CHAIN_ID = "net-chain"
+
+
+class Node:
+    def __init__(self, idx, pv, genesis):
+        self.idx = idx
+        self.pv = pv
+        self.app = KVStoreApplication()
+        self.conns = AppConns(local_client_creator(self.app))
+        self.conns.start()
+        self.state_store = StateStore(MemDB())
+        self.block_store = BlockStore(MemDB())
+        state = state_from_genesis(genesis)
+        state = Handshaker(self.state_store, state, self.block_store,
+                           genesis).handshake(self.conns.consensus, self.conns.query)
+        self.state_store.save(state)
+        self.mempool = CListMempool(self.conns.mempool)
+        self.event_bus = EventBus()
+        self.block_exec = BlockExecutor(self.state_store, self.conns.consensus,
+                                        self.mempool, EmptyEvidencePool(),
+                                        self.block_store, self.event_bus)
+        self.cs = ConsensusState(test_consensus_config(), state, self.block_exec,
+                                 self.block_store)
+        self.cs.set_priv_validator(pv)
+        self.cs.set_event_bus(self.event_bus)
+        self.mempool.tx_available_callbacks.append(self.cs.notify_txs_available)
+        self.switch = Switch(f"node{idx}")
+        self.cs_reactor = ConsensusReactor(self.cs)
+        self.switch.add_reactor("CONSENSUS", self.cs_reactor)
+        self.mp_reactor = MempoolReactor(self.mempool, gossip_sleep=0.005)
+        self.switch.add_reactor("MEMPOOL", self.mp_reactor)
+
+    async def start(self):
+        await self.switch.start()
+        await self.cs.start()
+
+    async def stop(self):
+        await self.cs.stop()
+        await self.switch.stop()
+
+
+def make_net(n):
+    pvs = [MockPV(crypto.Ed25519PrivKey.generate(bytes([0x60 + i]) * 32))
+           for i in range(n)]
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID, genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10) for pv in pvs])
+    nodes = [Node(i, pv, genesis) for i, pv in enumerate(pvs)]
+    return nodes
+
+
+async def wait_all_height(nodes, height, timeout=30.0):
+    async def one(node):
+        sub = node.event_bus.subscribe("netwait", tme.QUERY_NEW_BLOCK)
+        try:
+            while node.cs.state.last_block_height < height:
+                await sub.next()
+        finally:
+            node.event_bus.unsubscribe_all("netwait")
+
+    await asyncio.wait_for(asyncio.gather(*(one(nd) for nd in nodes)), timeout)
+
+
+def test_four_validator_net_makes_progress():
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 3)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        heights = [nd.cs.state.last_block_height for nd in nodes]
+        assert min(heights) >= 3, heights
+        # all nodes agree on block 2's hash
+        hashes = {nd.block_store.load_block_meta(2).header.hash() for nd in nodes}
+        assert len(hashes) == 1
+
+    asyncio.run(run())
+
+
+def test_tx_gossip_and_commit_all_nodes():
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            # submit the tx at ONE node; gossip must spread it, consensus commit it
+            nodes[2].mempool.check_tx(b"gossip=works")
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                if all(nd.app.state.get("gossip") == "works" for nd in nodes):
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        f"tx not committed everywhere: "
+                        f"{[nd.app.state for nd in nodes]}")
+                await asyncio.sleep(0.05)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+
+    asyncio.run(run())
+
+
+def test_progress_with_one_node_down():
+    async def run():
+        # 4 validators, one never starts: 3/4 = 75% > 2/3 → progress
+        nodes = make_net(4)
+        net = InProcNetwork()
+        live = nodes[:3]
+        for nd in live:
+            net.add_switch(nd.switch)
+        for nd in live:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(live, 2, timeout=60)
+        finally:
+            for nd in live:
+                await nd.stop()
+        assert all(nd.cs.state.last_block_height >= 2 for nd in live)
+
+    asyncio.run(run())
+
+
+def test_late_node_catches_up():
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        late = nodes[3]
+        for nd in nodes[:3]:
+            net.add_switch(nd.switch)
+        for nd in nodes[:3]:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes[:3], 3, timeout=60)
+            # now bring in the late node: catchup gossip must feed it old
+            # block parts + commit votes
+            net.add_switch(late.switch)
+            await late.start()
+            for other in nodes[:3]:
+                await net.connect(late.switch.node_id, other.switch.node_id)
+            await wait_all_height([late], 3, timeout=60)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        assert late.cs.state.last_block_height >= 3
+
+    asyncio.run(run())

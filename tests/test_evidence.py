@@ -1,0 +1,139 @@
+"""Evidence pool: verification, pooling, gossip, and block inclusion end-to-end."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.evidence import EvidencePool, verify_duplicate_vote
+from tendermint_tpu.evidence.pool import _PENDING_PREFIX
+from tendermint_tpu.evidence.reactor import EvidenceReactor
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.types import DuplicateVoteEvidence, SignedMsgType, Vote
+from tendermint_tpu.types.basic import BlockID, PartSetHeader
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_consensus_net import Node, make_net, wait_all_height  # noqa: E402
+from tendermint_tpu.p2p import InProcNetwork  # noqa: E402
+
+BID_A = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+BID_B = BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32))
+
+
+def make_conflicting_votes(nodes, height):
+    """Two signed precommits for different blocks at `height` by validator 0."""
+    node = nodes[0]
+    chain_id = node.cs.state.chain_id
+    val_set = node.state_store.load_validators(height)
+    val = val_set.validators[0]
+    idx, _ = val_set.get_by_address(val.address)
+    signer = next(nd for nd in nodes
+                  if nd.pv.get_pub_key().address() == val.address)
+    meta = node.block_store.load_block_meta(height)
+    ts = meta.header.time_ns
+
+    votes = []
+    for bid in (BID_A, BID_B):
+        v = Vote(SignedMsgType.PRECOMMIT, height, 0, bid, ts, val.address, idx)
+        signer.pv.sign_vote(chain_id, v)
+        votes.append(v)
+    return votes, val_set, ts
+
+
+def attach_pool(node):
+    pool = EvidencePool(MemDB(), node.state_store, node.block_store)
+    pool.set_state(node.cs.state)
+    node.block_exec.evpool = pool
+    node.cs.evpool = pool
+    return pool
+
+
+def test_duplicate_vote_evidence_verify_and_pool():
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        pools = [attach_pool(nd) for nd in nodes]
+        reactors = []
+        for nd, pool in zip(nodes, pools):
+            r = EvidenceReactor(pool, gossip_sleep=0.01)
+            nd.switch.add_reactor("EVIDENCE", r)
+            reactors.append(r)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2)
+            # byzantine: validator of node 0 signed two conflicting precommits at h=1
+            (va, vb), val_set, ts = make_conflicting_votes(nodes, 1)
+            ev = DuplicateVoteEvidence.new(va, vb, ts, val_set)
+            verify_duplicate_vote(ev, nodes[0].cs.state.chain_id, val_set)
+            # keep pool state fresh before adding
+            for nd, pool in zip(nodes, pools):
+                pool.set_state(nd.cs.state)
+            pools[1].add_evidence(ev)
+            assert pools[1].is_pending(ev)
+
+            # gossip spreads it, proposers include it, block commits it
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                if all(p.is_committed(ev) for p in pools):
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    states = [(p.is_pending(ev), p.is_committed(ev)) for p in pools]
+                    raise AssertionError(f"evidence not committed everywhere: {states}")
+                await asyncio.sleep(0.05)
+            # committed evidence pruned from pending
+            assert all(not p.is_pending(ev) for p in pools)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+
+    asyncio.run(run())
+
+
+def test_consensus_reports_conflicting_votes_to_pool():
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        pools = [attach_pool(nd) for nd in nodes]
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2)
+            target = nodes[1]
+            # inject two conflicting signed votes for the CURRENT height into
+            # node 1's machine: VoteSet raises ErrVoteConflictingVotes, the
+            # state machine reports to the pool's consensus buffer
+            h = target.cs.rs.height
+            chain_id = target.cs.state.chain_id
+            val_set = target.cs.rs.validators
+            byz_node = nodes[0]
+            val = val_set.validators[0]
+            # find which node's pv is validator index 0
+            byz = next(nd for nd in nodes
+                       if nd.pv.get_pub_key().address() == val.address)
+            from tendermint_tpu.consensus.state import VoteMessage
+
+            for bid in (BID_A, BID_B):
+                v = Vote(SignedMsgType.PRECOMMIT, h, 0, bid,
+                         1_800_000_000_000_000_000, val.address, 0)
+                byz.pv.sign_vote(chain_id, v)
+                await target.cs.add_peer_msg(VoteMessage(v), "byzpeer")
+            deadline = asyncio.get_event_loop().time() + 10
+            while not pools[1]._consensus_buffer:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError("conflicting votes never reported")
+                await asyncio.sleep(0.02)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+
+    asyncio.run(run())
