@@ -36,15 +36,17 @@ class Point(NamedTuple):
     t: jnp.ndarray
 
 
-def identity(n: int) -> Point:
-    zero = jnp.zeros((F.NLIMBS, n), dtype=jnp.uint32)
+def identity(batch_shape) -> Point:
+    if isinstance(batch_shape, int):
+        batch_shape = (batch_shape,)
+    zero = jnp.zeros((F.NLIMBS,) + tuple(batch_shape), dtype=jnp.uint32)
     one = zero.at[0].set(1)
     return Point(zero, one, one, zero)
 
 
 def add(p: Point, q: Point) -> Point:
     """Complete extended addition (2*d variant), ~9 field muls."""
-    d2 = F.const(D2_INT)
+    d2 = F.const(D2_INT, p.x.ndim - 1)
     a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
     b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
     c = F.mul(F.mul(p.t, q.t), d2)
@@ -103,21 +105,22 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     Rejects y >= p, non-square x^2, and x == 0 with sign 1 — identical rules
     to the host ed25519._recover_x.
     """
-    one = F.const(1)
+    nb = y_limbs.ndim - 1
+    one = F.const(1, nb)
     # canonical check: y < p  (freeze is identity for canonical 15-bit input;
     # compare frozen value against the raw input limbs)
     y_ok = jnp.all(F.freeze(y_limbs) == y_limbs, axis=0)
 
     yy = F.sqr(y_limbs)
     u = F.sub(yy, one)
-    v = F.add(F.mul(yy, F.const(D_INT)), one)
+    v = F.add(F.mul(yy, F.const(D_INT, nb)), one)
     v3 = F.mul(F.sqr(v), v)
     v7 = F.mul(F.sqr(v3), v)
     x = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
     vxx = F.mul(v, F.sqr(x))
     ok_direct = F.eq(vxx, u)
     ok_flip = F.eq(vxx, F.neg(u))
-    x = jnp.where(ok_direct, x, F.mul(x, F.const(SQRT_M1_INT)))
+    x = jnp.where(ok_direct, x, F.mul(x, F.const(SQRT_M1_INT, nb)))
     on_curve = ok_direct | ok_flip
 
     x_is_zero = F.is_zero(x)
@@ -147,8 +150,9 @@ def _select_point(table: Point, digits: jnp.ndarray) -> Point:
 
     Arithmetic one-hot select (predictable on TPU; avoids lane-varying gather).
     """
-    oh = (jnp.arange(16, dtype=jnp.uint32)[:, None] == digits[None, :]).astype(jnp.uint32)
-    sel = lambda t: jnp.einsum("jln,jn->ln", t, oh)
+    oh = (jnp.arange(16, dtype=jnp.uint32).reshape((16,) + (1,) * digits.ndim)
+          == digits[None]).astype(jnp.uint32)
+    sel = lambda t: jnp.einsum("jl...,j...->l...", t, oh)
     return Point(sel(table.x), sel(table.y), sel(table.z), sel(table.t))
 
 
@@ -159,8 +163,8 @@ def scalar_mul_windowed(p: Point, digits: jnp.ndarray) -> Point:
     64 iterations of 4 doublings + one table add. No data-dependent control
     flow; everything is batched across N.
     """
-    n = p.x.shape[1]
-    entries = [identity(n), p]
+    batch_shape = p.x.shape[1:]
+    entries = [identity(batch_shape), p]
     for _ in range(14):
         entries.append(add(entries[-1], p))
     table = Point(*(jnp.stack([getattr(e, c) for e in entries]) for c in ("x", "y", "z", "t")))
@@ -170,7 +174,7 @@ def scalar_mul_windowed(p: Point, digits: jnp.ndarray) -> Point:
         dig = jax.lax.dynamic_index_in_dim(digits, 63 - i, axis=0, keepdims=False)
         return add(acc, _select_point(table, dig))
 
-    return jax.lax.fori_loop(0, 64, body, identity(n))
+    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))
 
 
 # --- fixed-base multiplication ([s]B) --------------------------------------
@@ -231,13 +235,14 @@ def base_table() -> jnp.ndarray:
 def scalar_mul_base(digits: jnp.ndarray) -> Point:
     """[s]B with s = sum digits[i] * 16^i, digits (64, N); 64 mixed adds, no doublings."""
     table = base_table()  # (64, 16, 3, 17)
-    n = digits.shape[1]
+    batch_shape = digits.shape[1:]
 
     def body(i, acc):
         row = jax.lax.dynamic_index_in_dim(table, i, axis=0, keepdims=False)  # (16,3,17)
-        dig = jax.lax.dynamic_index_in_dim(digits, i, axis=0, keepdims=False)  # (N,)
-        oh = (jnp.arange(16, dtype=jnp.uint32)[:, None] == dig[None, :]).astype(jnp.uint32)
-        ent = jnp.einsum("jcl,jn->cln", row, oh)  # (3,17,N)
+        dig = jax.lax.dynamic_index_in_dim(digits, i, axis=0, keepdims=False)  # (*batch,)
+        oh = (jnp.arange(16, dtype=jnp.uint32).reshape((16,) + (1,) * dig.ndim)
+              == dig[None]).astype(jnp.uint32)
+        ent = jnp.einsum("jcl,j...->cl...", row, oh)  # (3,17,*batch)
         return add_niels(acc, Niels(ent[0], ent[1], ent[2]))
 
-    return jax.lax.fori_loop(0, 64, body, identity(n))
+    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))

@@ -4,16 +4,20 @@ Design notes (why this representation):
 
 * TPU int32 multiply returns the low 32 bits only — no widening multiply and
   no fast int64. So limb products must fit in 32 bits *exactly*: with 15-bit
-  limbs (plus redundancy up to 2^15+2 after carries), products are < 2^31.
+  limbs (plus redundancy up to 2^15+57 after the parallel carry), products
+  are < 2^31.
 * 17 limbs x 15 bits = 255 bits exactly, so the modular fold is aligned:
   2^255 ≡ 19 (mod p) means column j+17 of a product folds into column j with
   a single multiply by 19 — no sub-limb shifting.
-* Every field element is shaped ``(17, N)`` (limb index leading, batch in the
-  trailing dim) so the batch rides the 128-wide VPU lanes and limb-indexed
-  slicing is cheap.
+* Field elements are shaped ``(17, *batch)``; the verify kernel uses
+  ``(17, N//128, 128)`` so per-limb slices land on full (8,128) vregs —
+  a flat ``(17, N)`` layout wastes 7/8 of every sublane on per-limb ops.
+* Carries are TWO data-parallel passes over all limbs (mask/shift/roll/add),
+  not a 17-step sequential chain: after column sums < 2^26, pass one leaves
+  limbs < 2^16.4, pass two < 2^15+57 — inside the mul input invariant.
 
-Invariant: limbs entering :func:`mul` are ``<= 2^15 + 2`` (guaranteed by
-:func:`carry`). All ops are jit/vmap-free pure jnp and shape-polymorphic in N.
+Invariant: limbs entering :func:`mul` are ``<= 2^15 + 57`` (guaranteed by
+:func:`carry`); products then stay < 2^31 and split column sums < 2^22.
 
 This replaces the scalar big-int arithmetic inside Go's x/crypto ed25519
 (reference crypto/ed25519/ed25519.go:148-155 → filippo.io/edwards25519 field)
@@ -37,7 +41,7 @@ P_INT = 2**255 - 19
 # p in limb form: limb0 = 2^15-19, limbs 1..16 = 2^15-1
 P_LIMBS = np.array([MASK - 18] + [MASK] * 16, dtype=np.uint32)
 # 2p in per-limb form with headroom for lazy subtraction: a + TWO_P - b >= 0
-# whenever b is carry-normalized (limbs <= 2^15+2 < 2^16-2).
+# whenever b is carry-normalized (limbs <= 2^15+57 < 2^16-38).
 TWO_P_LIMBS = (P_LIMBS * 2).astype(np.uint32)
 
 
@@ -90,30 +94,34 @@ def limbs_to_bytes(a: np.ndarray) -> np.ndarray:
 
 # --- device constants ------------------------------------------------------
 
-def const(x: int) -> jnp.ndarray:
-    """A field constant as a (17, 1) device array (broadcasts over batch)."""
-    return jnp.asarray(int_to_limbs(x % P_INT).reshape(NLIMBS, 1))
+def const(x: int, batch_ndim: int = 1) -> jnp.ndarray:
+    """A field constant shaped (17, 1, ..) broadcasting over the batch dims."""
+    shape = (NLIMBS,) + (1,) * batch_ndim
+    return jnp.asarray(int_to_limbs(x % P_INT).reshape(shape))
+
+
+def _bcast(limbs_1d: np.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    shape = (NLIMBS,) + (1,) * (like.ndim - 1)
+    return jnp.asarray(limbs_1d.reshape(shape))
 
 
 # --- core ops --------------------------------------------------------------
 
 def carry(c: jnp.ndarray) -> jnp.ndarray:
-    """Carry-propagate column sums (< 2^26 per limb) to limbs <= 2^15+2.
+    """Parallel carry: column sums (< 2^26 per limb) -> limbs <= 2^15+57.
 
-    One full sequential pass, fold the >=2^255 overflow back via x19, then one
-    extra step limb0->limb1. Post-condition: limb0 < 2^15, limb1 <= 2^15+2,
-    limbs 2..16 < 2^15 — all safe as mul inputs.
+    Each pass: split every limb into low 15 bits + carry, shift the carries up
+    one limb (top carry folds into limb 0 via x19). Two passes bound the
+    result: pass 1 leaves limbs < 2^15 + 19*2^11; pass 2 < 2^15 + 57.
+    All ops are full-width vector ops over (17, *batch) — no sequential chain.
     """
-    c = list(jnp.split(c.astype(jnp.uint32), NLIMBS, axis=0))
-    for i in range(NLIMBS - 1):
-        c[i + 1] = c[i + 1] + (c[i] >> RADIX)
-        c[i] = c[i] & MASK
-    top = c[16] >> RADIX
-    c[16] = c[16] & MASK
-    c[0] = c[0] + 19 * top
-    c[1] = c[1] + (c[0] >> RADIX)
-    c[0] = c[0] & MASK
-    return jnp.concatenate(c, axis=0)
+    c = c.astype(jnp.uint32)
+    for _ in range(2):
+        lo = c & MASK
+        hi = c >> RADIX
+        hi_rolled = jnp.concatenate([hi[NLIMBS - 1:] * 19, hi[:NLIMBS - 1]], axis=0)
+        c = lo + hi_rolled
+    return c
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -121,28 +129,39 @@ def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    two_p = jnp.asarray(TWO_P_LIMBS.reshape(NLIMBS, 1))
+    two_p = _bcast(TWO_P_LIMBS, a)
     return carry(a + two_p - b)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    two_p = jnp.asarray(TWO_P_LIMBS.reshape(NLIMBS, 1))
-    return carry(two_p - a + jnp.zeros_like(a))
+    two_p = _bcast(TWO_P_LIMBS, a)
+    return carry(two_p - a)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply. Inputs carry-normalized (limbs <= 2^15+2)."""
-    # outer products, split into 15-bit halves so column sums stay < 2^26
-    prod = a[:, None, :] * b[None, :, :]          # (17, 17, N), each < 2^31
-    lo = prod & MASK
-    hi = prod >> RADIX
+    """Field multiply. Inputs carry-normalized (limbs <= 2^15+57).
+
+    Columns are built with static rolls over a padded limb axis (independent
+    per column — no scatter chain): col[k] = sum_i lo[i, k-i] + hi[i, k-1-i].
+    """
+    prod = a[:, None] * b[None]                   # (17, 17, *batch), < 2^31
+    lo = prod & MASK                              # <= 2^15-1
+    hi = prod >> RADIX                            # < 2^16
     batch_shape = prod.shape[2:]
-    cols = jnp.zeros((2 * NLIMBS, ) + batch_shape, dtype=jnp.uint32)
-    for i in range(NLIMBS):
-        cols = cols.at[i:i + NLIMBS].add(lo[i])
-        cols = cols.at[i + 1:i + 1 + NLIMBS].add(hi[i])
-    # fold columns 17..33 back with x19 (2^255 ≡ 19): c_j += 19*c_{j+17}
-    folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
+    pad_shape = (NLIMBS, NLIMBS + 1) + batch_shape
+    z = jnp.zeros(pad_shape, dtype=jnp.uint32)
+    lo_p = jnp.concatenate([lo, z], axis=1)       # (17, 34+1? no: 17+17+1)
+    hi_p = jnp.concatenate([hi, z], axis=1)
+    ncols = 2 * NLIMBS + 1
+    # roll row i right by i (lo) / i+1 (hi) along the column axis, then sum rows
+    rolled = [jnp.roll(lo_p[i], i, axis=0) for i in range(NLIMBS)]
+    rolled += [jnp.roll(hi_p[i], i + 1, axis=0) for i in range(NLIMBS)]
+    cols = rolled[0]
+    for r in rolled[1:]:
+        cols = cols + r                           # (34+..., *batch); < 2^22
+    # fold columns 17.. back with x19 (2^255 ≡ 19): c_j += 19*c_{j+17}
+    high = cols[NLIMBS:2 * NLIMBS]
+    folded = cols[:NLIMBS] + 19 * high
     return carry(folded)
 
 
@@ -155,24 +174,26 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     prod = a * jnp.uint32(k)
     lo = prod & MASK
     hi = prod >> RADIX
-    cols = jnp.zeros((NLIMBS + 1,) + a.shape[1:], dtype=jnp.uint32).at[:NLIMBS].add(lo)
-    cols = cols.at[1:NLIMBS + 1].add(hi)
-    folded = cols[:NLIMBS].at[0].add(19 * cols[NLIMBS])
-    return carry(folded)
+    hi_rolled = jnp.concatenate([hi[NLIMBS - 1:] * 19, hi[:NLIMBS - 1]], axis=0)
+    return carry(lo + hi_rolled)
 
 
 def freeze(a: jnp.ndarray) -> jnp.ndarray:
     """Reduce to the canonical representative in [0, p); limbs strictly 15-bit."""
-    # Repeated passes settle redundancy: after pass 2 the value is
-    # < 2^255 + 2^241; pass 3 folds any remaining >=2^255 excess; pass 4 runs
-    # with no fold and leaves every limb strictly 15-bit. (Each pass is 18
-    # cheap vector ops; freeze runs only ~4x per verification.)
+    # Parallel passes settle all redundancy (inputs here are carry-normalized,
+    # so two more passes leave every limb strictly 15-bit with at most one
+    # conditional subtract of p remaining).
+    a = carry(carry(a))
+    # strictly-15-bit pass: one more sequential-free pass may leave limb0
+    # marginally above; run the cheap parallel pass twice more for safety
     a = carry(a)
-    a = carry(a)
-    a = carry(a)
-    a = carry(a)
-    # now value < 2^255, limbs < 2^15 strictly; conditionally subtract p once
-    p = jnp.asarray(P_LIMBS.reshape(NLIMBS, 1))
+    lo = a & MASK
+    hi = a >> RADIX
+    hi_rolled = jnp.concatenate([hi[NLIMBS - 1:] * 19, hi[:NLIMBS - 1]], axis=0)
+    a = lo + hi_rolled
+    # now value < 2^255 + eps, limbs < 2^15 + 19: conditionally subtract p
+    # (sequential borrow chain, but freeze runs only a handful of times)
+    p = _bcast(P_LIMBS, a)
     d = list(jnp.split(a.astype(jnp.int32) - p.astype(jnp.int32), NLIMBS, axis=0))
     for i in range(NLIMBS - 1):
         borrow = (d[i] >> 31) & 1          # 1 if negative
@@ -182,21 +203,31 @@ def freeze(a: jnp.ndarray) -> jnp.ndarray:
     d[16] = d[16] + (final_borrow << RADIX)
     diff = jnp.concatenate(d, axis=0)
     ge_p = (final_borrow == 0)             # a >= p
-    return jnp.where(ge_p, diff.astype(jnp.uint32), a)
+    out = jnp.where(ge_p, diff.astype(jnp.uint32), a)
+    # one more conditional subtract covers the redundancy window (a < 2p + eps)
+    d2 = list(jnp.split(out.astype(jnp.int32) - p.astype(jnp.int32), NLIMBS, axis=0))
+    for i in range(NLIMBS - 1):
+        borrow = (d2[i] >> 31) & 1
+        d2[i] = d2[i] + (borrow << RADIX)
+        d2[i + 1] = d2[i + 1] - borrow
+    final_borrow2 = (d2[16] >> 31) & 1
+    d2[16] = d2[16] + (final_borrow2 << RADIX)
+    diff2 = jnp.concatenate(d2, axis=0)
+    return jnp.where(final_borrow2 == 0, diff2.astype(jnp.uint32), out)
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    """(N,) bool: a ≡ 0 (mod p)."""
+    """(*batch,) bool: a ≡ 0 (mod p)."""
     return jnp.all(freeze(a) == 0, axis=0)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(N,) bool: a ≡ b (mod p)."""
+    """(*batch,) bool: a ≡ b (mod p)."""
     return jnp.all(freeze(a) == freeze(b), axis=0)
 
 
 def parity(a: jnp.ndarray) -> jnp.ndarray:
-    """(N,) uint32: low bit of the canonical representative."""
+    """(*batch,) uint32: low bit of the canonical representative."""
     return freeze(a)[0] & 1
 
 

@@ -30,6 +30,9 @@ from . import field as F
 from ..ed25519 import L
 
 
+LANE = 128  # batch is reshaped to (B, 128) so per-limb ops fill (8,128) vregs
+
+
 @partial(jax.jit, static_argnums=())
 def _verify_kernel(a_y, a_sign, r_y, r_sign, s_digits, h_digits):
     A, ok_a = curve.decompress(a_y, a_sign)
@@ -50,8 +53,9 @@ def _nibbles(b: np.ndarray) -> np.ndarray:
 
 
 def _pad_to(n: int) -> int:
-    """Bucket batch sizes to limit jit recompiles."""
-    size = 64
+    """Bucket batch sizes to limit jit recompiles; multiple of 128 so the
+    batch reshapes exactly to (B, 128) lanes."""
+    size = LANE
     while size < n:
         size *= 2
     return size
@@ -88,24 +92,30 @@ def prepare_batch(
 
 
 def pack_device_inputs(pk_arr, r_arr, s_arr, h_arr, pad: int):
-    """numpy byte arrays -> padded device input arrays (limbs & digits)."""
+    """numpy byte arrays -> padded device inputs shaped (.., B, 128).
+
+    The 2-D batch layout puts 128 items on the lane axis and B = pad/128 on
+    sublanes, so every per-limb (1, B, 128) slice occupies whole vregs.
+    """
     n = pk_arr.shape[0]
     if pad > n:
         z = lambda a: np.pad(a, ((0, pad - n), (0, 0)))
         pk_arr, r_arr, s_arr, h_arr = z(pk_arr), z(r_arr), z(s_arr), z(h_arr)
-    a_sign = (pk_arr[:, 31] >> 7).astype(np.uint32)
-    r_sign = (r_arr[:, 31] >> 7).astype(np.uint32)
+    b = pad // LANE
+    a_sign = (pk_arr[:, 31] >> 7).astype(np.uint32).reshape(b, LANE)
+    r_sign = (r_arr[:, 31] >> 7).astype(np.uint32).reshape(b, LANE)
     pk_m = pk_arr.copy()
     pk_m[:, 31] &= 0x7F
     r_m = r_arr.copy()
     r_m[:, 31] &= 0x7F
+    shape3 = (F.NLIMBS, b, LANE)
     return (
-        F.bytes_to_limbs(pk_m),
+        F.bytes_to_limbs(pk_m).reshape(shape3),
         a_sign,
-        F.bytes_to_limbs(r_m),
+        F.bytes_to_limbs(r_m).reshape(shape3),
         r_sign,
-        _nibbles(s_arr),
-        _nibbles(h_arr),
+        _nibbles(s_arr).reshape(64, b, LANE),
+        _nibbles(h_arr).reshape(64, b, LANE),
     )
 
 
@@ -118,5 +128,5 @@ def batch_verify(
         return np.zeros(0, dtype=bool)
     pk_arr, r_arr, s_arr, h_arr, ok = prepare_batch(pks, msgs, sigs)
     dev_in = pack_device_inputs(pk_arr, r_arr, s_arr, h_arr, _pad_to(n))
-    verdict = np.asarray(_verify_kernel(*dev_in))[:n]
+    verdict = np.asarray(_verify_kernel(*dev_in)).reshape(-1)[:n]
     return verdict & ok
