@@ -141,27 +141,23 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiply. Inputs carry-normalized (limbs <= 2^15+57).
 
-    Columns are built with static rolls over a padded limb axis (independent
-    per column — no scatter chain): col[k] = sum_i lo[i, k-i] + hi[i, k-1-i].
+    Columns are accumulated with static-slice scatter-adds
+    (``cols.at[i:i+NLIMBS].add``). A jnp.roll-based column build miscompiles
+    inside ``lax.fori_loop`` on the TPU backend (verified empirically: valid
+    signatures rejected on-device while CPU agrees with the host spec), so
+    this MUST stay scatter-based; the differential on-device suite in
+    tests/test_tpu_device.py guards it.
     """
     prod = a[:, None] * b[None]                   # (17, 17, *batch), < 2^31
     lo = prod & MASK                              # <= 2^15-1
     hi = prod >> RADIX                            # < 2^16
     batch_shape = prod.shape[2:]
-    pad_shape = (NLIMBS, NLIMBS + 1) + batch_shape
-    z = jnp.zeros(pad_shape, dtype=jnp.uint32)
-    lo_p = jnp.concatenate([lo, z], axis=1)       # (17, 34+1? no: 17+17+1)
-    hi_p = jnp.concatenate([hi, z], axis=1)
-    ncols = 2 * NLIMBS + 1
-    # roll row i right by i (lo) / i+1 (hi) along the column axis, then sum rows
-    rolled = [jnp.roll(lo_p[i], i, axis=0) for i in range(NLIMBS)]
-    rolled += [jnp.roll(hi_p[i], i + 1, axis=0) for i in range(NLIMBS)]
-    cols = rolled[0]
-    for r in rolled[1:]:
-        cols = cols + r                           # (34+..., *batch); < 2^22
+    cols = jnp.zeros((2 * NLIMBS,) + batch_shape, dtype=jnp.uint32)
+    for i in range(NLIMBS):
+        cols = cols.at[i:i + NLIMBS].add(lo[i])
+        cols = cols.at[i + 1:i + 1 + NLIMBS].add(hi[i])
     # fold columns 17.. back with x19 (2^255 ≡ 19): c_j += 19*c_{j+17}
-    high = cols[NLIMBS:2 * NLIMBS]
-    folded = cols[:NLIMBS] + 19 * high
+    folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
     return carry(folded)
 
 
@@ -178,21 +174,32 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return carry(lo + hi_rolled)
 
 
+def _seq_carry(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact 17-step sequential carry; top carry folds into limb 0 with x19."""
+    limbs = list(jnp.split(a, NLIMBS, axis=0))
+    for i in range(NLIMBS - 1):
+        c = limbs[i] >> RADIX
+        limbs[i] = limbs[i] & MASK
+        limbs[i + 1] = limbs[i + 1] + c
+    top = limbs[16] >> RADIX
+    limbs[16] = limbs[16] & MASK
+    limbs[0] = limbs[0] + top * 19
+    return jnp.concatenate(limbs, axis=0)
+
+
 def freeze(a: jnp.ndarray) -> jnp.ndarray:
     """Reduce to the canonical representative in [0, p); limbs strictly 15-bit."""
-    # Parallel passes settle all redundancy (inputs here are carry-normalized,
-    # so two more passes leave every limb strictly 15-bit with at most one
-    # conditional subtract of p remaining).
+    # Two parallel passes settle the bulk redundancy, then exact sequential
+    # passes guarantee strictly-15-bit limbs (a purely parallel chain can
+    # leave a limb >= 2^15 when a carry must walk through a run of 0x7fff
+    # limbs — representation-dependent eq()/is_zero() otherwise).
     a = carry(carry(a))
-    # strictly-15-bit pass: one more sequential-free pass may leave limb0
-    # marginally above; run the cheap parallel pass twice more for safety
-    a = carry(a)
-    lo = a & MASK
-    hi = a >> RADIX
-    hi_rolled = jnp.concatenate([hi[NLIMBS - 1:] * 19, hi[:NLIMBS - 1]], axis=0)
-    a = lo + hi_rolled
-    # now value < 2^255 + eps, limbs < 2^15 + 19: conditionally subtract p
-    # (sequential borrow chain, but freeze runs only a handful of times)
+    a = _seq_carry(a)
+    a = _seq_carry(a)
+    a = _seq_carry(a)
+    a = _seq_carry(a)
+    # now limbs strictly 15-bit, value < 2^255 < 2p: conditionally subtract p
+    # once (sequential borrow chain, but freeze runs only a handful of times)
     p = _bcast(P_LIMBS, a)
     d = list(jnp.split(a.astype(jnp.int32) - p.astype(jnp.int32), NLIMBS, axis=0))
     for i in range(NLIMBS - 1):
@@ -203,17 +210,7 @@ def freeze(a: jnp.ndarray) -> jnp.ndarray:
     d[16] = d[16] + (final_borrow << RADIX)
     diff = jnp.concatenate(d, axis=0)
     ge_p = (final_borrow == 0)             # a >= p
-    out = jnp.where(ge_p, diff.astype(jnp.uint32), a)
-    # one more conditional subtract covers the redundancy window (a < 2p + eps)
-    d2 = list(jnp.split(out.astype(jnp.int32) - p.astype(jnp.int32), NLIMBS, axis=0))
-    for i in range(NLIMBS - 1):
-        borrow = (d2[i] >> 31) & 1
-        d2[i] = d2[i] + (borrow << RADIX)
-        d2[i + 1] = d2[i + 1] - borrow
-    final_borrow2 = (d2[16] >> 31) & 1
-    d2[16] = d2[16] + (final_borrow2 << RADIX)
-    diff2 = jnp.concatenate(d2, axis=0)
-    return jnp.where(final_borrow2 == 0, diff2.astype(jnp.uint32), out)
+    return jnp.where(ge_p, diff.astype(jnp.uint32), a)
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:

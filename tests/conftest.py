@@ -1,18 +1,49 @@
 """Test env: force JAX onto CPU with 8 virtual devices so sharding/multi-chip
 paths are exercised without TPU hardware (the driver benches on the real chip).
 
-Must run before any jax import. The image's sitecustomize registers the axon
-TPU backend whenever PALLAS_AXON_POOL_IPS is set and the environment pins
-JAX_PLATFORMS=axon — both must be overridden (not setdefault'ed) or the whole
-suite silently runs on the real chip through the remote-compile relay.
+The image's sitecustomize (PYTHONPATH=/root/.axon_site) registers the axon TPU
+backend and imports jax *at interpreter startup* — before pytest loads this
+file — so setting JAX_PLATFORMS here is too late (jax reads it at import).
+``jax.config.update("jax_platforms", ...)`` still works because backends
+initialize lazily on the first ``jax.devices()`` call; XLA_FLAGS is likewise
+read at backend-init time. A hard assertion below makes any regression loud
+instead of silently benching the whole suite through the TPU relay.
+
+Set TM_ON_DEVICE=1 to skip the pin and run the on-device differential suite
+(tests/test_tpu_device.py) against the real chip.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon backend registration
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+ON_DEVICE = os.environ.get("TM_ON_DEVICE") == "1"
+
+
+def pytest_collection_modifyitems(config, items):
+    # With the CPU pin disabled, only the on-device suite may run — anything
+    # else would silently exercise the TPU relay (and assume 8 devices).
+    if ON_DEVICE:
+        import pytest
+
+        skip = pytest.mark.skip(reason="TM_ON_DEVICE=1 runs only tests/test_tpu_device.py")
+        for item in items:
+            if "test_tpu_device" not in str(item.fspath):
+                item.add_marker(skip)
+
+
+if not ON_DEVICE:
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", (
+        "CPU pin failed: suite would silently run on "
+        f"{jax.default_backend()!r}; jax backends were initialized before "
+        "conftest ran"
+    )
+    assert len(jax.devices()) == 8, (
+        f"expected 8 virtual CPU devices, got {len(jax.devices())}"
+    )
