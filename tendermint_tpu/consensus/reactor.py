@@ -329,9 +329,15 @@ class ConsensusReactor(Reactor):
     # -- switch-to-consensus (reactor.go:108) ------------------------------
 
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        if state.last_block_height > 0:
+            self.cs.reconstruct_last_commit(state)
         self.cs.update_to_state(state)
         self.wait_sync = False
         self._broadcast_new_round_step(self.cs.rs)
+        if self.cs._receive_task is None:
+            # the state machine was held back while sync ran (reference
+            # reactor.go:108 SwitchToConsensus → conS.Start)
+            asyncio.create_task(self.cs.start())
 
     # -- inbound -----------------------------------------------------------
 

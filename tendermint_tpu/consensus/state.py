@@ -109,8 +109,10 @@ class ConsensusState:
         self.n_steps = 0
         self._replay_mode = False
 
-        self.update_to_state(state)
+        # reconstruct BEFORE update: updateToState requires rs.last_commit
+        # when starting on an existing chain (reference state.go NewState)
         self.reconstruct_last_commit(state)
+        self.update_to_state(state)
 
     # -- wiring ------------------------------------------------------------
 

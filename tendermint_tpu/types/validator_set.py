@@ -394,6 +394,64 @@ class ValidatorSet:
         return vs
 
 
+def verify_commit_light_batched(
+    entries: Sequence[Tuple["ValidatorSet", str, BlockID, int, object]],
+) -> List[Optional[Exception]]:
+    """Window-batched VerifyCommitLight: many (valset, commit) pairs, ONE
+    device call.
+
+    The fast-sync replay path (reference blockchain/v0/reactor.go:255 verifies
+    one commit per loop iteration) is the TPU batch opportunity: all candidate
+    signatures across a window of contiguous blocks go to the device together,
+    then each commit's scalar precedence loop — including the 2/3 early exit —
+    is replayed over its verdict slice. Per-entry outcome is None (ok) or the
+    exact exception verify_commit_light would have raised.
+
+    Entries: (val_set, chain_id, block_id, height, commit).
+    """
+    bv = BatchVerifier()
+    slices: List[Tuple[int, List[int]]] = []  # (batch offset, candidate idxs)
+    shape_errors: List[Optional[Exception]] = []
+    off = 0
+    for val_set, chain_id, block_id, height, commit in entries:
+        try:
+            val_set._check_commit_shape(commit, height, block_id)
+        except Exception as e:  # shape errors surface per-entry, not batch-wide
+            shape_errors.append(e)
+            slices.append((off, []))
+            continue
+        shape_errors.append(None)
+        idxs = [i for i, cs in enumerate(commit.signatures) if cs.for_block()]
+        for idx in idxs:
+            bv.add(val_set.validators[idx].pub_key,
+                   commit.vote_sign_bytes(chain_id, idx),
+                   commit.signatures[idx].signature)
+        slices.append((off, idxs))
+        off += len(idxs)
+    _, per_item = bv.verify()
+
+    results: List[Optional[Exception]] = []
+    for entry, shape_err, (start, idxs) in zip(entries, shape_errors, slices):
+        if shape_err is not None:
+            results.append(shape_err)
+            continue
+        val_set, chain_id, block_id, height, commit = entry
+        tallied = 0
+        needed = val_set.total_voting_power() * 2 // 3
+        err: Optional[Exception] = None
+        for pos, idx in enumerate(idxs):
+            if not per_item[start + pos]:
+                err = ErrWrongSignature(idx, commit.signatures[idx].signature)
+                break
+            tallied += val_set.validators[idx].voting_power
+            if tallied > needed:
+                break
+        else:
+            err = ErrNotEnoughVotingPowerSigned(tallied, needed)
+        results.append(err)
+    return results
+
+
 def _process_changes(changes: List[Validator]) -> Tuple[List[Validator], List[Validator]]:
     """Sort by address, reject dups/negatives, split updates/removals
     (validator_set.go:373)."""

@@ -30,6 +30,8 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(skip)
 
 
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
+
 if not ON_DEVICE:
     xla_flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in xla_flags:
@@ -39,6 +41,10 @@ if not ON_DEVICE:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # The ed25519 verify kernel takes minutes to compile on CPU; a persistent
+    # cache makes repeat suite runs fast (first run still pays the compiles).
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     assert jax.default_backend() == "cpu", (
         "CPU pin failed: suite would silently run on "
         f"{jax.default_backend()!r}; jax backends were initialized before "

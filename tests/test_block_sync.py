@@ -1,0 +1,292 @@
+"""Block sync (fast sync v0 semantics): pool scheduling, windowed batched
+commit verification, and an in-proc e2e where a fresh node fast-syncs a
+200-block chain from a peer and joins consensus
+(reference blockchain/v0/{pool,reactor}.go; VERDICT round-1 item #4).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.blockchain import BlockchainReactor, BlockPool
+from tendermint_tpu.blockchain.msgs import (
+    BlockRequest,
+    BlockResponse,
+    NoBlockResponse,
+    StatusRequest,
+    StatusResponse,
+    decode_msg,
+    encode_msg,
+)
+from tendermint_tpu.consensus import ConsensusState
+from tendermint_tpu.consensus.config import test_consensus_config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis
+from tendermint_tpu.state.execution import EmptyEvidencePool, NoOpMempool
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import (
+    BlockID,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    SignedMsgType,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.block import Commit
+from tendermint_tpu.types.validator_set import verify_commit_light_batched
+from tendermint_tpu.types.errors import ErrWrongSignature
+from tendermint_tpu.p2p import InProcNetwork, Switch
+
+CHAIN_ID = "sync-chain"
+
+
+# -- chain builder -----------------------------------------------------------
+
+def build_chain(n_blocks, pv, genesis):
+    """Hand-build a committed chain: returns (final state, stores, commits)."""
+    state = state_from_genesis(genesis)
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state_store.save(state)
+    executor = BlockExecutor(state_store, conns.consensus, NoOpMempool(),
+                             EmptyEvidencePool(), block_store)
+    last_commit = Commit(0, 0, BlockID(), [])
+    for h in range(1, n_blocks + 1):
+        proposer = state.validators.get_proposer().address
+        block, parts = state.make_block(h, [f"h{h}=v".encode()], last_commit,
+                                        [], proposer)
+        bid = BlockID(block.hash(), parts.header())
+        vs = VoteSet(state.chain_id, h, 0, SignedMsgType.PRECOMMIT,
+                     state.validators)
+        v = Vote(SignedMsgType.PRECOMMIT, h, 0, bid, block.header.time_ns + 1,
+                 state.validators.validators[0].address, 0)
+        pv.sign_vote(state.chain_id, v)
+        vs.add_vote(v)
+        seen = vs.make_commit()
+        block_store.save_block(block, parts, seen)
+        state, _ = executor.apply_block(state, bid, block)
+        last_commit = seen
+    return state, state_store, block_store, conns, app
+
+
+@pytest.fixture
+def one_val_genesis():
+    pv = MockPV(crypto.Ed25519PrivKey.generate(b"\x21" * 32))
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID, genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    return pv, genesis
+
+
+# -- pool unit tests ---------------------------------------------------------
+
+def test_pool_schedule_and_consume():
+    pool = BlockPool(start_height=1)
+    pool.set_peer_range("p1", 1, 50)
+    reqs = pool.schedule_requests()
+    heights = sorted(h for _pid, h in reqs)
+    assert heights[0] == 1 and len(heights) <= 50
+    assert all(pid == "p1" for pid, _h in reqs[:5])
+    # per-peer pending cap respected
+    assert len(reqs) <= 16
+    assert pool.schedule_requests() == []  # nothing new until capacity frees
+
+
+def test_pool_redo_punishes_provider():
+    pool = BlockPool(start_height=1)
+    pool.set_peer_range("bad", 1, 10)
+
+    class _B:  # stand-in block
+        def __init__(self, h):
+            from types import SimpleNamespace
+
+            self.header = SimpleNamespace(height=h)
+
+    for pid, h in pool.schedule_requests():
+        pool.add_block(pid, _B(h))
+    assert len(pool.peek_window(5)) == 5
+    bad = pool.redo(1)
+    assert bad == {"bad"}
+    assert pool.peek_window(5) == []
+    # peer is gone; nothing schedulable until another peer reports in
+    assert pool.schedule_requests() == []
+    assert not pool.is_caught_up()
+
+
+def test_pool_caught_up():
+    pool = BlockPool(start_height=11)
+    pool.set_peer_range("p", 1, 10)
+    assert pool.is_caught_up()
+
+
+# -- wire codec --------------------------------------------------------------
+
+def test_blockchain_msg_roundtrip(one_val_genesis):
+    pv, genesis = one_val_genesis
+    state, _ss, bs, conns, _app = build_chain(2, pv, genesis)
+    blk = bs.load_block(1)
+    for msg in (BlockRequest(7), NoBlockResponse(9), StatusRequest(),
+                StatusResponse(12, 3), BlockResponse(blk)):
+        out = decode_msg(encode_msg(msg))
+        if isinstance(msg, BlockResponse):
+            assert out.block.hash() == blk.hash()
+        else:
+            assert out == msg
+    conns.stop()
+
+
+# -- windowed batched verification -------------------------------------------
+
+def test_verify_commit_light_batched_window(one_val_genesis, monkeypatch):
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    pv, genesis = one_val_genesis
+    state, _ss, bs, conns, _app = build_chain(12, pv, genesis)
+    # entries: verify block h's seen commit against the (static) valset
+    entries = []
+    for h in range(1, 11):
+        blk = bs.load_block(h)
+        bid = BlockID(blk.hash(), blk.make_part_set().header())
+        entries.append((state.validators, CHAIN_ID, bid, h, bs.load_seen_commit(h)))
+    results = verify_commit_light_batched(entries)
+    assert all(r is None for r in results)
+
+    # corrupt one commit in the middle: only that entry errors
+    bad_commit = bs.load_seen_commit(5)
+    sig = bytearray(bad_commit.signatures[0].signature)
+    sig[0] ^= 1
+    bad_commit.signatures[0].signature = bytes(sig)
+    entries[4] = (state.validators, CHAIN_ID, entries[4][2], 5, bad_commit)
+    results = verify_commit_light_batched(entries)
+    assert isinstance(results[4], ErrWrongSignature)
+    assert all(r is None for i, r in enumerate(results) if i != 4)
+    conns.stop()
+
+
+def test_verify_commit_light_batched_device_path(one_val_genesis):
+    """>=16 sigs in one call routes to the jax kernel; decisions unchanged."""
+    pv, genesis = one_val_genesis
+    state, _ss, bs, conns, _app = build_chain(20, pv, genesis)
+    entries = []
+    for h in range(1, 19):
+        blk = bs.load_block(h)
+        bid = BlockID(blk.hash(), blk.make_part_set().header())
+        entries.append((state.validators, CHAIN_ID, bid, h, bs.load_seen_commit(h)))
+    results = verify_commit_light_batched(entries)
+    assert all(r is None for r in results)
+    conns.stop()
+
+
+# -- e2e: fresh node fast-syncs then joins consensus --------------------------
+
+class SyncNode:
+    """A full node wired for fast sync (consensus held back until synced).
+
+    Pass chain=(state, state_store, block_store, conns, app) to start on an
+    existing chain (the source node); otherwise starts fresh from genesis.
+    """
+
+    def __init__(self, name, genesis, pv=None, fast_sync=True, chain=None,
+                 config=None):
+        from tendermint_tpu.consensus.replay import Handshaker
+        from tendermint_tpu.mempool import CListMempool
+        from tendermint_tpu.types.event_bus import EventBus
+
+        if chain is not None:
+            self.state, self.state_store, self.block_store, self.conns, self.app = chain
+        else:
+            self.app = KVStoreApplication()
+            self.conns = AppConns(local_client_creator(self.app))
+            self.conns.start()
+            self.state_store = StateStore(MemDB())
+            self.block_store = BlockStore(MemDB())
+            self.state = state_from_genesis(genesis)
+            self.state_store.save(self.state)
+            self.state = Handshaker(self.state_store, self.state, self.block_store,
+                                    genesis).handshake(self.conns.consensus,
+                                                       self.conns.query)
+            self.state_store.save(self.state)
+        self.mempool = CListMempool(self.conns.mempool)
+        self.event_bus = EventBus()
+        self.block_exec = BlockExecutor(self.state_store, self.conns.consensus,
+                                        self.mempool, EmptyEvidencePool(),
+                                        self.block_store, self.event_bus)
+        self.cs = ConsensusState(config or test_consensus_config(), self.state,
+                                 self.block_exec, self.block_store)
+        if pv is not None:
+            self.cs.set_priv_validator(pv)
+        self.cs.set_event_bus(self.event_bus)
+        self.mempool.tx_available_callbacks.append(self.cs.notify_txs_available)
+        self.switch = Switch(name)
+        self.cs_reactor = ConsensusReactor(self.cs, wait_sync=fast_sync)
+        self.switch.add_reactor("CONSENSUS", self.cs_reactor)
+        self.bc_reactor = BlockchainReactor(
+            self.state, self.block_exec, self.block_store,
+            fast_sync=fast_sync, consensus_reactor=self.cs_reactor)
+        self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)
+        self.fast_sync = fast_sync
+
+    async def start(self):
+        await self.switch.start()
+        if not self.fast_sync:
+            await self.cs.start()
+
+    async def stop(self):
+        await self.cs.stop()
+        await self.switch.stop()
+        self.conns.stop()
+
+
+def test_fast_sync_200_blocks_then_join_consensus(one_val_genesis, monkeypatch):
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")  # keep CPU suite fast
+    pv, genesis = one_val_genesis
+
+    async def run():
+        # source: 200 pre-built blocks (its app replayed them); its consensus
+        # only proposes when txs arrive so it doesn't race ahead of the sync
+        from dataclasses import replace
+
+        quiet = replace(test_consensus_config(), create_empty_blocks=False)
+        chain = build_chain(200, pv, genesis)
+        src = SyncNode("src", genesis, pv=pv, fast_sync=False, chain=chain,
+                       config=quiet)
+        fresh = SyncNode("fresh", genesis, pv=None, fast_sync=True,
+                         config=quiet)
+
+        net = InProcNetwork()
+        net.add_switch(src.switch)
+        net.add_switch(fresh.switch)
+        await src.start()
+        await fresh.start()
+        await net.connect("src", "fresh")
+        try:
+            # fresh node must fast-sync the chain and switch to consensus
+            await asyncio.wait_for(fresh.bc_reactor.synced.wait(), timeout=90)
+            assert fresh.bc_reactor.blocks_synced >= 190
+            h_sync = fresh.state_store.load().last_block_height
+            assert h_sync >= 199
+            # ...then follow live consensus: a tx at the source must commit a
+            # new block that the freshly-synced node also applies
+            src.mempool.check_tx(b"post=sync")
+            deadline = asyncio.get_event_loop().time() + 60
+            while asyncio.get_event_loop().time() < deadline:
+                if fresh.app.state.get("post") == "sync":
+                    break
+                await asyncio.sleep(0.1)
+            assert fresh.app.state.get("post") == "sync", \
+                "fresh node did not join consensus"
+            assert fresh.state_store.load().last_block_height >= 201
+            # app state agrees with the source chain
+            assert fresh.app.state.get("h5") == "v"
+        finally:
+            await fresh.stop()
+            await src.stop()
+
+    asyncio.run(run())
