@@ -1,12 +1,23 @@
-"""Benchmark: VerifyCommit signature throughput, batched TPU path vs host scalar.
+"""Benchmark: Ed25519 commit-verification throughput, TPU stream vs host scalar.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Config #2/#3 of BASELINE.json: a synthetic 1024-signature commit batch
-(vote sign-bytes identical in shape to types.Commit.vote_sign_bytes output).
+BASELINE.json config #1: the batched verifier on realistic vote sign-bytes
+(identical in shape to types.Commit.vote_sign_bytes output), measured as
+*sustained* throughput — a stream of 1024-signature chunks verified by one
+``lax.scan`` inside a single device execution. That is the shape of the real
+hot paths (fast-sync replay, 10k-validator commits, vote-stream batches):
+dispatching one jitted call has a large fixed cost on remote-attached TPUs
+(~100 ms through a relay), so per-call latency at batch 1024 measures the
+link, not the machine; the stream amortizes it exactly the way the
+consensus/blocksync callers do.
+
 Baseline = the host scalar loop (OpenSSL-backed PubKey.verify_signature, the
 stand-in for the reference's Go x/crypto ed25519.Verify hot call at
-crypto/ed25519/ed25519.go:148-155).
+crypto/ed25519/ed25519.go:148-155), measured on a 2048-signature subset.
+
+Timing includes host-side packing (prepare_batch) — the device path is
+charged end-to-end, same as the baseline loop.
 """
 
 import json
@@ -14,55 +25,64 @@ import time
 
 import numpy as np
 
+N_STREAM = 32768
+CHUNK = 1024
+N_BASE = 2048
+
 
 def build_batch(n: int):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
     from tendermint_tpu import crypto
     from tendermint_tpu.types import BlockID, PartSetHeader, SignedMsgType
     from tendermint_tpu.types.canonical import vote_sign_bytes
 
     bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+    rng = np.random.default_rng(7)
     pks, msgs, sigs, pubs = [], [], [], []
     for i in range(n):
-        priv = crypto.Ed25519PrivKey.generate(i.to_bytes(2, "big") * 16)
+        priv = Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+        pub_bytes = priv.public_key().public_bytes_raw()
         # realistic vote sign-bytes (unique timestamp per validator)
         msg = vote_sign_bytes("bench-chain", SignedMsgType.PRECOMMIT, 100, 0,
                               bid, 1_700_000_000_000_000_000 + i)
-        pub = priv.pub_key()
-        pks.append(pub.bytes())
+        pks.append(pub_bytes)
         msgs.append(msg)
         sigs.append(priv.sign(msg))
-        pubs.append(pub)
+        pubs.append(crypto.Ed25519PubKey(pub_bytes))
     return pks, msgs, sigs, pubs
 
 
 def main():
-    n = 1024
-    pks, msgs, sigs, pubs = build_batch(n)
+    pks, msgs, sigs, pubs = build_batch(N_STREAM)
 
-    from tendermint_tpu.crypto.ed25519_jax import batch_verify
+    from tendermint_tpu.crypto.ed25519_jax import batch_verify_stream
 
-    # warmup: compile the kernel (cached across runs by jax platform cache)
-    out = batch_verify(pks, msgs, sigs)
-    assert np.asarray(out).all(), "warmup batch rejected valid sigs"
+    # warmup: compile the stream kernel at the measured shape (cached across
+    # runs by the jax persistent cache when available)
+    out = batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)
+    assert np.asarray(out).all(), "warmup stream rejected valid sigs"
 
-    # device path: best of 5 timed runs
+    # device path: best of 3 timed runs, end-to-end incl. host packing
     device_times = []
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
-        out = batch_verify(pks, msgs, sigs)
+        out = batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)
         device_times.append(time.perf_counter() - t0)
     assert np.asarray(out).all()
-    device_sigs_per_sec = n / min(device_times)
+    device_sigs_per_sec = N_STREAM / min(device_times)
 
     # host scalar baseline (the reference's one-verify-per-signature loop)
     t0 = time.perf_counter()
-    ok = all(pub.verify_signature(m, s) for pub, m, s in zip(pubs, msgs, sigs))
+    ok = all(pub.verify_signature(m, s)
+             for pub, m, s in zip(pubs[:N_BASE], msgs[:N_BASE], sigs[:N_BASE]))
     host_elapsed = time.perf_counter() - t0
     assert ok
-    host_sigs_per_sec = n / host_elapsed
+    host_sigs_per_sec = N_BASE / host_elapsed
 
     print(json.dumps({
-        "metric": "verify_commit_sigs_per_sec_batch1024",
+        "metric": "verify_commit_sigs_per_sec_stream1024",
         "value": round(device_sigs_per_sec, 1),
         "unit": "sigs/s",
         "vs_baseline": round(device_sigs_per_sec / host_sigs_per_sec, 3),

@@ -73,7 +73,8 @@ class BlockPool:
         if not self._peers:
             return False
         # reference: caught up when within 1 of the best peer
-        return self.height >= max(1, self._max_peer_height)
+        # (pool.go IsCaughtUp: height >= maxPeerHeight - 1)
+        return self.height >= max(1, self._max_peer_height - 1)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -120,17 +121,29 @@ class BlockPool:
 
     # -- block arrival (pool.go AddBlock) -----------------------------------
 
-    def add_block(self, peer_id: str, block: Block) -> bool:
-        """Accept a block if it matches an outstanding request from peer_id."""
+    def add_block(self, peer_id: str, block: Block) -> str:
+        """Accept a block matching an outstanding request from peer_id.
+
+        Returns "added", "stale" (a legitimate-but-late response: the height
+        was processed already or the request timed out and was reassigned —
+        NOT a peer fault), or "unsolicited" (we never asked this peer for
+        anything near this height — a spam/bandwidth fault, reference
+        reactor stops the peer).
+        """
         h = block.header.height
         req = self._requests.get(h)
         if req is None or req.peer_id != peer_id or req.block is not None:
-            return False
+            # reference pool.go AddBlock: only a height far (>100) from the
+            # pool's cursor is a peer fault; anything near it is a late
+            # response to a request we timed out/deleted/reassigned
+            if abs(h - self.height) > 100:
+                return "unsolicited"
+            return "stale"
         req.block = block
         info = self._peers.get(peer_id)
         if info is not None:
             info.pending -= 1
-        return True
+        return "added"
 
     def no_block(self, peer_id: str, height: int) -> None:
         req = self._requests.get(height)

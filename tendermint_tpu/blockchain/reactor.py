@@ -40,6 +40,13 @@ from .pool import BlockPool
 
 logger = logging.getLogger("tmtpu.blockchain")
 
+
+class FatalSyncError(Exception):
+    """A deterministic local fault during block application: the reference
+    panics here (v0/reactor.go ApplyBlock err); we stop the sync loop and
+    propagate so the node halts and restart replay reconciles."""
+
+
 # verify/apply at most this many blocks per batch; bounds device batch size
 # (10k validators x 64 blocks = 640k sigs would exceed one comfortable batch)
 VERIFY_WINDOW = 16
@@ -106,7 +113,17 @@ class BlockchainReactor(Reactor):
         elif isinstance(msg, StatusResponse):
             self.pool.set_peer_range(peer.id, msg.base, msg.height)
         elif isinstance(msg, BlockResponse):
-            self.pool.add_block(peer.id, msg.block)
+            status = self.pool.add_block(peer.id, msg.block)
+            if status == "unsolicited":
+                # never requested from anyone: peer error, not a free
+                # bandwidth vector (reference reactor treats it as such).
+                # "stale" (timed-out/reassigned request arriving late) is an
+                # honest slow peer and is silently dropped.
+                logger.warning("unsolicited block h=%d from %s",
+                               msg.block.header.height, peer.id)
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, f"unsolicited block at {msg.block.header.height}")
         elif isinstance(msg, NoBlockResponse):
             self.pool.no_block(peer.id, msg.height)
 
@@ -137,6 +154,9 @@ class BlockchainReactor(Reactor):
                         return
                 await asyncio.sleep(POLL_INTERVAL)
             except asyncio.CancelledError:
+                raise
+            except FatalSyncError:
+                logger.critical("fatal block-sync error; halting sync loop")
                 raise
             except Exception:
                 logger.exception("pool routine error")
@@ -196,14 +216,14 @@ class BlockchainReactor(Reactor):
             _vs, _chain, block_id, _h, _commit = entry
             parts = blk.make_part_set()
             self.store.save_block(blk, parts, nxt.last_commit)
+            # a commit-verified block that fails to apply is a deterministic
+            # local fault (bad app or corrupt state), not a peer fault
             try:
                 self.state, _retain = self.block_exec.apply_block(
                     self.state, block_id, blk)
             except Exception as e:
-                bad = self.pool.redo(blk.header.height)
-                bad.update({peer_id, npeer_id})
-                await self._punish(bad, f"apply failed at {blk.header.height}: {e}")
-                return
+                raise FatalSyncError(
+                    f"apply_block failed at {blk.header.height}: {e}") from e
             self.pool.pop()
             self.blocks_synced += 1
 

@@ -1,0 +1,306 @@
+"""Master node configuration: 9 sections + TOML round-trip.
+
+Mirrors the reference's config system (config/config.go:66 Config struct:
+Base :158, RPC :305, P2P :517, Mempool :686, StateSync :792, FastSync :882,
+Consensus :917, Storage :1081, TxIndex :1117, Instrumentation :1148) and its
+TOML template writer (config/toml.go). Reading uses stdlib ``tomllib``;
+writing emits a commented template so an operator can hand-edit the file the
+same way the reference's ``tendermint init`` output allows.
+
+Defaults match the reference's DefaultConfig() values where they translate
+(Go durations become float seconds).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import asdict, dataclass, field, fields
+from typing import List, Optional
+
+from .consensus.config import ConsensusConfig
+
+DEFAULT_DIR = ".tmtpu"
+CONFIG_DIR = "config"
+DATA_DIR = "data"
+
+
+@dataclass
+class BaseConfig:
+    """(config/config.go:158 BaseConfig)"""
+
+    chain_id: str = ""
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_backend: str = "sqlite"       # sqlite | mem (tm-db analog, libs/db.py)
+    db_dir: str = "data"
+    log_level: str = "info"
+    log_format: str = "plain"        # plain | json
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    abci: str = "local"              # local | socket
+    proxy_app: str = "kvstore"       # app name or tcp://host:port when socket
+    filter_peers: bool = False
+
+
+@dataclass
+class RPCConfig:
+    """(config/config.go:305 RPCConfig)"""
+
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: List[str] = field(default_factory=list)
+    grpc_laddr: str = ""
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+    max_body_bytes: int = 1000000
+    max_header_bytes: int = 1 << 20
+    pprof_laddr: str = ""
+
+
+@dataclass
+class P2PConfig:
+    """(config/config.go:517 P2PConfig)"""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    unconditional_peer_ids: str = ""
+    persistent_peers_max_dial_period: float = 0.0
+    flush_throttle_timeout: float = 0.1
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+
+
+@dataclass
+class MempoolConfig:
+    """(config/config.go:686 MempoolConfig)"""
+
+    version: str = "v0"
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5000
+    max_txs_bytes: int = 1073741824
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1048576
+    max_batch_bytes: int = 0
+    ttl_duration: float = 0.0
+    ttl_num_blocks: int = 0
+
+
+@dataclass
+class StateSyncConfig:
+    """(config/config.go:792 StateSyncConfig)"""
+
+    enable: bool = False
+    rpc_servers: List[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0
+    discovery_time: float = 15.0
+    temp_dir: str = ""
+    chunk_request_timeout: float = 10.0
+    chunk_fetchers: int = 4
+
+
+@dataclass
+class FastSyncConfig:
+    """(config/config.go:882 FastSyncConfig)"""
+
+    version: str = "v0"
+
+
+@dataclass
+class StorageConfig:
+    """(config/config.go:1081 StorageConfig)"""
+
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class TxIndexConfig:
+    """(config/config.go:1117 TxIndexConfig)"""
+
+    indexer: str = "kv"              # kv | null
+
+
+@dataclass
+class InstrumentationConfig:
+    """(config/config.go:1148 InstrumentationConfig)"""
+
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "tendermint"
+
+
+_SECTIONS = [
+    ("rpc", RPCConfig), ("p2p", P2PConfig), ("mempool", MempoolConfig),
+    ("statesync", StateSyncConfig), ("fastsync", FastSyncConfig),
+    ("consensus", ConsensusConfig), ("storage", StorageConfig),
+    ("tx_index", TxIndexConfig), ("instrumentation", InstrumentationConfig),
+]
+
+
+@dataclass
+class Config:
+    """The master config (config/config.go:66). ``root_dir`` is the home."""
+
+    root_dir: str = DEFAULT_DIR
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    # -- path helpers (reference config.go rootify) -------------------------
+
+    def _rootify(self, path: str) -> str:
+        if os.path.isabs(path):
+            return path
+        return os.path.join(self.root_dir, path)
+
+    def genesis_file(self) -> str:
+        return self._rootify(self.base.genesis_file)
+
+    def priv_validator_key_file(self) -> str:
+        return self._rootify(self.base.priv_validator_key_file)
+
+    def priv_validator_state_file(self) -> str:
+        return self._rootify(self.base.priv_validator_state_file)
+
+    def node_key_file(self) -> str:
+        return self._rootify(self.base.node_key_file)
+
+    def db_dir(self) -> str:
+        return self._rootify(self.base.db_dir)
+
+    def wal_file(self) -> str:
+        wf = self.consensus.wal_file or os.path.join("data", "cs.wal", "wal")
+        return self._rootify(wf)
+
+    # -- validation (per-section ValidateBasic) ------------------------------
+
+    def validate_basic(self) -> None:
+        if self.base.db_backend not in ("sqlite", "mem"):
+            raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
+        if self.base.abci not in ("local", "socket"):
+            raise ValueError(f"unknown abci mode {self.base.abci!r}")
+        if self.mempool.size <= 0:
+            raise ValueError("mempool.size must be positive")
+        if self.mempool.cache_size < 0:
+            raise ValueError("mempool.cache_size must be non-negative")
+        for name in ("timeout_propose", "timeout_prevote", "timeout_precommit",
+                     "timeout_commit"):
+            if getattr(self.consensus, name) < 0:
+                raise ValueError(f"consensus.{name} cannot be negative")
+        if self.statesync.enable:
+            if len(self.statesync.rpc_servers) < 2:
+                raise ValueError("statesync requires >= 2 rpc_servers")
+            if self.statesync.trust_height <= 0:
+                raise ValueError("statesync.trust_height must be set")
+        if self.fastsync.version not in ("v0",):
+            raise ValueError(f"unknown fastsync version {self.fastsync.version!r}")
+        if self.tx_index.indexer not in ("kv", "null"):
+            raise ValueError(f"unknown indexer {self.tx_index.indexer!r}")
+
+    # -- TOML round-trip -----------------------------------------------------
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or os.path.join(self.root_dir, CONFIG_DIR, "config.toml")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+        return path
+
+    def to_toml(self) -> str:
+        out = ["# tendermint-tpu node configuration",
+               "# edit and restart the node to apply\n"]
+        for fld in fields(BaseConfig):
+            out.append(_toml_kv(fld.name, getattr(self.base, fld.name)))
+        for section, _cls in _SECTIONS:
+            cfg = getattr(self, section)
+            out.append(f"\n[{section}]")
+            for fld in fields(cfg):
+                out.append(_toml_kv(fld.name, getattr(cfg, fld.name)))
+        return "\n".join(out) + "\n"
+
+    @classmethod
+    def load(cls, root_dir: str, path: Optional[str] = None) -> "Config":
+        path = path or os.path.join(root_dir, CONFIG_DIR, "config.toml")
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        cfg = cls(root_dir=root_dir)
+        base_fields = {f.name for f in fields(BaseConfig)}
+        for k, v in doc.items():
+            if k in base_fields:
+                setattr(cfg.base, k, v)
+        for section, seccls in _SECTIONS:
+            sec = doc.get(section)
+            if not isinstance(sec, dict):
+                continue
+            target = getattr(cfg, section)
+            known = {f.name for f in fields(seccls)}
+            for k, v in sec.items():
+                if k in known:
+                    setattr(target, k, v)
+        return cfg
+
+
+def _toml_kv(key: str, value) -> str:
+    if isinstance(value, bool):
+        return f"{key} = {'true' if value else 'false'}"
+    if isinstance(value, (int, float)):
+        return f"{key} = {value}"
+    if isinstance(value, str):
+        return f'{key} = {_toml_str(value)}'
+    if isinstance(value, list):
+        inner = ", ".join(_toml_str(v) if isinstance(v, str) else str(v) for v in value)
+        return f"{key} = [{inner}]"
+    raise TypeError(f"cannot encode config value {key}={value!r}")
+
+
+def _toml_str(v: str) -> str:
+    return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def default_config(root_dir: str = DEFAULT_DIR) -> Config:
+    return Config(root_dir=root_dir)
+
+
+def test_config(root_dir: str) -> Config:
+    """Fast-timeout config for tests/localnets (reference ResetTestRoot)."""
+    from .consensus.config import test_consensus_config
+
+    cfg = Config(root_dir=root_dir)
+    cfg.consensus = test_consensus_config()
+    cfg.base.db_backend = "mem"
+    return cfg
+
+
+test_config.__test__ = False  # not a pytest test despite the name

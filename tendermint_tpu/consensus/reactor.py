@@ -39,6 +39,9 @@ from .state import BlockPartMessage, ConsensusState, ProposalMessage, VoteMessag
 
 logger = logging.getLogger("tmtpu.cs.reactor")
 
+# cap on detached preverify-and-forward tasks before peer backpressure kicks in
+MAX_INFLIGHT_PREVERIFY = 1024
+
 
 class PeerRoundState:
     """What we know about a peer's consensus state (consensus/types/peer_round_state.go)."""
@@ -285,6 +288,9 @@ class ConsensusReactor(Reactor):
         self.wait_sync = wait_sync  # True while fast sync runs
         self._peer_states: Dict[str, PeerState] = {}
         self._gossip_tasks: Dict[str, List[asyncio.Task]] = {}
+        # strong refs to detached preverify-and-forward tasks (the loop keeps
+        # only weak refs; a GC'd task would drop the vote silently)
+        self._inflight: set = set()
         # subscribe to internal state events for broadcasts
         cs.new_round_step_listeners.append(self._broadcast_new_round_step)
         cs.valid_block_listeners.append(self._broadcast_new_valid_block)
@@ -336,10 +342,43 @@ class ConsensusReactor(Reactor):
         self._broadcast_new_round_step(self.cs.rs)
         if self.cs._receive_task is None:
             # the state machine was held back while sync ran (reference
-            # reactor.go:108 SwitchToConsensus → conS.Start)
-            asyncio.create_task(self.cs.start())
+            # reactor.go:108 SwitchToConsensus → conS.Start). Keep a strong
+            # reference: the event loop holds only weak refs to tasks, and a
+            # GC'd wrapper would silently drop consensus startup.
+            self._start_task = asyncio.create_task(self.cs.start())
 
     # -- inbound -----------------------------------------------------------
+
+    async def _preverify_and_forward(self, vote, peer_id: str) -> None:
+        """Pre-verify then enqueue to the state machine. Vote delivery order
+        is irrelevant (VoteSet is a set keyed by validator index)."""
+        await self._preverify_vote(vote)
+        await self.cs.add_peer_msg(VoteMessage(vote), peer_id)
+
+    async def _preverify_vote(self, vote) -> None:
+        """Feed the vote's signature into the micro-batch verifier so the
+        state machine's VoteSet.add_vote hits the verdict cache. Best-effort:
+        any miss (unknown height/index) falls back to the host scalar path
+        inside VoteSet — decisions are identical either way."""
+        try:
+            rs = self.cs.rs
+            if vote.height == rs.height and rs.validators is not None:
+                vals = rs.validators
+            elif (vote.height == rs.height - 1
+                  and rs.last_commit is not None):
+                vals = rs.last_commit.val_set
+            else:
+                return
+            if not (0 <= vote.validator_index < vals.size()):
+                return
+            _addr, val = vals.get_by_index(vote.validator_index)
+            if val is None or val.pub_key.address() != vote.validator_address:
+                return
+            await self.cs.vote_verifier.preverify(
+                val.pub_key, vote.sign_bytes(self.cs.state.chain_id),
+                vote.signature)
+        except Exception:  # never let pre-verification break gossip
+            logger.debug("vote preverify skipped", exc_info=True)
 
     async def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
         msg = decode_msg(msg_bytes)
@@ -400,7 +439,22 @@ class ConsensusReactor(Reactor):
                 ps.ensure_vote_bit_arrays(height - 1, last_size)
                 ps.set_has_vote(msg.vote.height, msg.vote.round, msg.vote.type,
                                 msg.vote.validator_index)
-                await self.cs.add_peer_msg(VoteMessage(msg.vote), peer.id)
+                # HOT LOOP #1: pre-verify the signature, then forward — as a
+                # detached task so this peer's dispatch loop keeps reading
+                # while the verifier accumulates a batch across peers
+                # (vote_set.go:205 equivalent; crypto/vote_batcher.py).
+                # Correctness never depends on it: a cache miss in VoteSet
+                # falls back to the host scalar verify.
+                if len(self._inflight) < MAX_INFLIGHT_PREVERIFY:
+                    t = asyncio.create_task(
+                        self._preverify_and_forward(msg.vote, peer.id))
+                    self._inflight.add(t)
+                    t.add_done_callback(self._inflight.discard)
+                else:
+                    # backpressure: a vote-flooding peer must not grow the
+                    # task set unboundedly — block its dispatch loop (the
+                    # bounded cs queue then applies, as before the change)
+                    await self._preverify_and_forward(msg.vote, peer.id)
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage):
                 if rs.height == msg.height:

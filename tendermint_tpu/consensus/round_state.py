@@ -68,10 +68,12 @@ class HeightVoteSet:
     allow round skipping.
     """
 
-    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet,
+                 verifier=None):
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
+        self.verifier = verifier
         self.round = 0
         self._round_vote_sets: Dict[int, Tuple[VoteSet, VoteSet]] = {}
         self._peer_catchup_rounds: Dict[str, List[int]] = {}
@@ -82,9 +84,11 @@ class HeightVoteSet:
         if round_ in self._round_vote_sets:
             return
         prevotes = VoteSet(self.chain_id, self.height, round_,
-                           SignedMsgType.PREVOTE, self.val_set)
+                           SignedMsgType.PREVOTE, self.val_set,
+                           verifier=self.verifier)
         precommits = VoteSet(self.chain_id, self.height, round_,
-                             SignedMsgType.PRECOMMIT, self.val_set)
+                             SignedMsgType.PRECOMMIT, self.val_set,
+                             verifier=self.verifier)
         self._round_vote_sets[round_] = (prevotes, precommits)
 
     def set_round(self, round_: int) -> None:

@@ -101,6 +101,13 @@ class ConsensusState:
         self.valid_block_listeners: List[Callable[[RoundState], None]] = []
         self.vote_listeners: List[Callable[[Vote], None]] = []
 
+        # HOT LOOP #1 seam: gossiped-vote signature checks go through a
+        # micro-batching verifier (crypto/vote_batcher.py). The reactor
+        # pre-verifies concurrently in batches; the single-writer loop then
+        # consumes cached verdicts via VoteSet.add_vote.
+        from ..crypto.vote_batcher import BatchVoteVerifier
+        self.vote_verifier = BatchVoteVerifier()
+
         self._queue: "asyncio.Queue" = asyncio.Queue(maxsize=1000)
         self._timeout_task: Optional[asyncio.Task] = None
         self._pending_timeout: Optional[TimeoutInfo] = None
@@ -172,7 +179,17 @@ class ConsensusState:
                           step: RoundStep) -> None:
         ti = TimeoutInfo(duration_s, height, round_, int(step))
         old = self._pending_timeout
-        # newer timeouts for same/later (H,R,S) override (ticker.go timeoutRoutine)
+        # ignore timeouts for an earlier-or-equal (H,R,S) than the last one
+        # scheduled (ticker.go:94 timeoutRoutine) — a stray earlier-step
+        # schedule must not cancel a later-step timeout (liveness hazard)
+        if old is not None:
+            if ti.height < old.height:
+                return
+            if ti.height == old.height:
+                if ti.round < old.round:
+                    return
+                if ti.round == old.round and old.step > 0 and ti.step <= old.step:
+                    return
         if self._timeout_task is not None:
             self._timeout_task.cancel()
         self._pending_timeout = ti
@@ -322,7 +339,8 @@ class ConsensusState:
         rs.valid_round = -1
         rs.valid_block = None
         rs.valid_block_parts = None
-        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.votes = HeightVoteSet(state.chain_id, height, state.validators,
+                                 verifier=self.vote_verifier)
         rs.commit_round = -1
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False

@@ -69,14 +69,16 @@ class BatchVerifier:
 
         non_ed_idx = {i: pk for i, pk in non_ed}
         if backend == "jax":
-            from .ed25519_jax import batch_verify
+            from .ed25519_jax import batch_verify_stream
 
             ed_pos = [i for i in range(n) if i not in non_ed_idx]
             out = np.zeros(n, dtype=bool)
             if ed_pos:
-                ed_out = batch_verify([pks[i] for i in ed_pos],
-                                      [msgs[i] for i in ed_pos],
-                                      [sigs[i] for i in ed_pos])
+                # batch_verify_stream == batch_verify below one chunk; above,
+                # it scans fixed-size chunks inside one device execution
+                ed_out = batch_verify_stream([pks[i] for i in ed_pos],
+                                             [msgs[i] for i in ed_pos],
+                                             [sigs[i] for i in ed_pos])
                 out[ed_pos] = ed_out
             # rare non-ed25519 keys verify on host, verdicts merged by index
             for i, pub in non_ed_idx.items():

@@ -4,4 +4,9 @@ See field.py (GF(2^255-19) limb arithmetic), curve.py (batched group ops),
 verify.py (host prep + jitted verification kernel).
 """
 
-from .verify import batch_verify, prepare_batch, pack_device_inputs  # noqa: F401
+from .verify import (  # noqa: F401
+    batch_verify,
+    batch_verify_stream,
+    pack_device_inputs,
+    prepare_batch,
+)

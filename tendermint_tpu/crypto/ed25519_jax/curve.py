@@ -28,6 +28,10 @@ from ..ed25519 import D as D_INT, SQRT_M1 as SQRT_M1_INT  # noqa: E402
 
 D2_INT = (2 * D_INT) % F.P_INT
 
+# NOTE on fori_loop unrolling: unroll>1 measured ~2x faster on an isolated
+# field-mul loop but consistently SLOWER on the full verify kernel (compile
+# blowup/VMEM pressure), so the loops below deliberately stay unroll=1.
+
 
 class Point(NamedTuple):
     x: jnp.ndarray
@@ -46,6 +50,14 @@ def identity(batch_shape) -> Point:
 
 def add(p: Point, q: Point) -> Point:
     """Complete extended addition (2*d variant), ~9 field muls."""
+    x, y, z, e, h = _add_xyz(p, q)
+    return Point(x, y, z, F.mul(e, h))
+
+
+def _add_xyz(p: Point, q: Point):
+    """Complete addition without the T output (8M): T = E*H is only needed
+    when the *next* op reads it — callers multiply the returned (e, h) pair
+    on demand (same deferral pattern as _dbl_xyz)."""
     d2 = F.const(D2_INT, p.x.ndim - 1)
     a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
     b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
@@ -56,11 +68,19 @@ def add(p: Point, q: Point) -> Point:
     f = F.sub(dd, c)
     g = F.add(dd, c)
     h = F.add(b, a)
-    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    return F.mul(e, f), F.mul(g, h), F.mul(f, g), e, h
 
 
 def dbl(p: Point) -> Point:
     """Doubling, 4M + 4S (mirrors the host _pt_dbl formulas exactly)."""
+    x, y, z, e, h = _dbl_xyz(p)
+    return Point(x, y, z, F.mul(e, h))
+
+
+def _dbl_xyz(p: Point):
+    """Doubling without the T output (3M + 4S): doubling never *reads* T, so
+    chains of doublings only need the final T — callers multiply the returned
+    (e, h) factors when (and only when) the next op consumes T."""
     a = F.sqr(p.x)
     b = F.sqr(p.y)
     c = F.sqr(p.z)
@@ -70,7 +90,7 @@ def dbl(p: Point) -> Point:
     e = F.sub(h, F.sqr(xy))
     g = F.sub(a, b)
     f = F.add(c, g)
-    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    return F.mul(e, f), F.mul(g, h), F.mul(f, g), e, h
 
 
 def neg(p: Point) -> Point:
@@ -118,8 +138,9 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     v7 = F.mul(F.sqr(v3), v)
     x = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
     vxx = F.mul(v, F.sqr(x))
-    ok_direct = F.eq(vxx, u)
-    ok_flip = F.eq(vxx, F.neg(u))
+    fvxx = F.freeze(vxx)  # shared between both equality probes
+    ok_direct = jnp.all(fvxx == F.freeze(u), axis=0)
+    ok_flip = jnp.all(fvxx == F.freeze(F.neg(u)), axis=0)
     x = jnp.where(ok_direct, x, F.mul(x, F.const(SQRT_M1_INT, nb)))
     on_curve = ok_direct | ok_flip
 
@@ -136,7 +157,14 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
 # --- encoding --------------------------------------------------------------
 
 def encode(p: Point):
-    """-> (y_canonical (17,N), sign (N,)): the 32-byte encoding, in limb form."""
+    """-> (y_canonical (17,N), sign (N,)): the 32-byte encoding, in limb form.
+
+    Uses the per-element Fermat chain: it is ~95% squarings (cheap via
+    F.sqr) at full batch width, and measured FASTER on TPU than a
+    Montgomery/product-tree batch inversion, whose narrow tree levels are
+    latency-bound (the tree's ~3 muls/element never pay for its 254-mul
+    width-1 root chain).
+    """
     zinv = F.inverse(p.z)
     x = F.freeze(F.mul(p.x, zinv))
     y = F.freeze(F.mul(p.y, zinv))
@@ -162,6 +190,11 @@ def scalar_mul_windowed(p: Point, digits: jnp.ndarray) -> Point:
     Fixed 4-bit windows: build [0..15]P once (15 complete adds), then
     64 iterations of 4 doublings + one table add. No data-dependent control
     flow; everything is batched across N.
+
+    The inner doublings use the T-free variant (_dbl_xyz): only the 4th
+    doubling of each window materializes T (consumed by the table add), and
+    the add itself defers its T product to the (e, h) pair carried across
+    iterations — 4 fewer field muls per window than the naive chain.
     """
     batch_shape = p.x.shape[1:]
     entries = [identity(batch_shape), p]
@@ -169,12 +202,21 @@ def scalar_mul_windowed(p: Point, digits: jnp.ndarray) -> Point:
         entries.append(add(entries[-1], p))
     table = Point(*(jnp.stack([getattr(e, c) for e in entries]) for c in ("x", "y", "z", "t")))
 
-    def body(i, acc):
-        acc = dbl(dbl(dbl(dbl(acc))))
+    def body(i, carry):
+        x, y, z, e_acc, h_acc = carry
+        acc = Point(x, y, z, None)
+        for k in range(4):
+            x, y, z, e, h = _dbl_xyz(acc)
+            acc = Point(x, y, z, F.mul(e, h) if k == 3 else None)
         dig = jax.lax.dynamic_index_in_dim(digits, 63 - i, axis=0, keepdims=False)
-        return add(acc, _select_point(table, dig))
+        q = _select_point(table, dig)
+        # complete add, deferring the output T = E*H to the carried pair
+        return _add_xyz(acc, q)
 
-    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))
+    ident = identity(batch_shape)
+    init = (ident.x, ident.y, ident.z, ident.x, ident.y)  # e*h = 0*1 = t
+    x, y, z, e, h = jax.lax.fori_loop(0, 64, body, init)
+    return Point(x, y, z, F.mul(e, h))
 
 
 # --- fixed-base multiplication ([s]B) --------------------------------------

@@ -162,7 +162,27 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """Field square: exploits product symmetry (p_ij + p_ji = 2·p_ij) to do
+    153 limb products instead of mul's 289 (~35% cheaper on the VPU).
+
+    Cross terms use a pre-doubled operand: a2 = 2a has limbs < 2^16+114, so
+    a2_i * a_j < 2^31.1 < 2^32 (uint32-safe); split columns then bound the
+    same as :func:`mul`.
+    """
+    a2 = a + a
+    batch_shape = a.shape[1:]
+    cols = jnp.zeros((2 * NLIMBS,) + batch_shape, dtype=jnp.uint32)
+    for i in range(NLIMBS):
+        # row i: diagonal a_i^2 at column 2i, then doubled cross terms
+        # a2_i * a_j for j in (i, 17) at columns i+j — one contiguous slice
+        row = jnp.concatenate([a[i:i + 1] * a[i:i + 1], a2[i:i + 1] * a[i + 1:]], axis=0)
+        lo = row & MASK
+        hi = row >> RADIX
+        width = NLIMBS - i
+        cols = cols.at[2 * i:2 * i + width].add(lo)
+        cols = cols.at[2 * i + 1:2 * i + 1 + width].add(hi)
+    folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
+    return carry(folded)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:

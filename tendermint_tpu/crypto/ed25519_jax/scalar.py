@@ -1,0 +1,137 @@
+"""Batched scalar arithmetic mod L = 2^252 + δ on device (radix-2^11, int32).
+
+Reduces the 512-bit SHA-512 challenge to h mod L and emits the 4-bit window
+digits the curve kernel consumes. Mirrors the role of Go x/crypto's
+ScReduce in the reference hot call (crypto/ed25519/ed25519.go:148-155).
+
+Radix 2^11 is chosen so that cross products of 11-bit limbs (< 2^22) sum
+over a 12-limb multiplicand without approaching the int32 limit, letting
+the fold products accumulate with no intermediate carries.
+
+Fold identity: 2^253 ≡ -2δ (mod L), δ = L - 2^252 < 2^125. Splitting a
+value at bit 253 (limb 23, since 23·11 = 253) gives h ≡ lo - hi·2δ. Three
+folds take 517 bits down to < 2^253 in magnitude (signed); adding 8L and
+four conditional subtractions (8L, 4L, 2L, L) then land in [0, L).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ed25519 import L as L_INT
+
+RADIX = 11
+MASK = (1 << RADIX) - 1
+NL = 23                      # 23 * 11 = 253 bits: fold boundary
+DELTA2_INT = 2 * (L_INT - 2**252)
+
+
+def _int_to_limbs11(x: int, n: int) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = (x >> (RADIX * i)) & MASK
+    return out
+
+
+_D2 = _int_to_limbs11(DELTA2_INT, 12)          # 2δ < 2^126: 12 limbs
+_L_MULTS = {m: _int_to_limbs11(m * L_INT, 24) for m in (8, 4, 2, 1)}
+
+
+def le32_to_limbs11(words: jnp.ndarray, nlimbs: int) -> list:
+    """(16, *batch) u32 LE words -> list of nlimbs (*batch,) int32 limbs."""
+    out = []
+    nwords = words.shape[0]
+    for k in range(nlimbs):
+        bit = RADIX * k
+        w, off = bit // 32, bit % 32
+        v = words[w] >> off
+        if off > 32 - RADIX and w + 1 < nwords:
+            v = v | (words[w + 1] << (32 - off))
+        out.append((v & MASK).astype(jnp.int32))
+    return out
+
+
+def _signed_carry(limbs: list) -> list:
+    """Sequential signed carry; all limbs land in [0, 2^11) except the top,
+    which keeps the sign of the overall value."""
+    out = list(limbs)
+    for i in range(len(out) - 1):
+        c = out[i] >> RADIX          # arithmetic shift: floor division
+        out[i] = out[i] - (c << RADIX)
+        out[i + 1] = out[i + 1] + c
+    return out
+
+
+def _fold(limbs: list) -> list:
+    """limbs (len > NL, carry-normalized, top limb signed) -> lo - hi·2δ."""
+    lo = limbs[:NL]
+    hi = limbs[NL:]
+    ncols = len(hi) + len(_D2) - 1
+    cols = [None] * ncols
+    for i, h in enumerate(hi):
+        for j, d in enumerate(_D2):
+            if int(d) == 0:
+                continue
+            t = h * np.int32(d)
+            cols[i + j] = t if cols[i + j] is None else cols[i + j] + t
+    # keep ≥ NL+1 limbs so the carry pushes any excess above bit 253 into
+    # limb NL, where the next fold's split can see it
+    n = max(NL + 1, ncols)
+    out = []
+    for k in range(n):
+        v = lo[k] if k < NL else None
+        c = cols[k] if k < ncols and cols[k] is not None else None
+        if v is None and c is None:
+            out.append(jnp.zeros_like(lo[0]))
+        elif c is None:
+            out.append(v)
+        elif v is None:
+            out.append(-c)
+        else:
+            out.append(v - c)
+    return _signed_carry(out)
+
+
+def _cond_sub(limbs: list, sub: np.ndarray) -> list:
+    """limbs (24, carry-normalized ≥ 0) -= sub if limbs >= sub (borrow probe)."""
+    d = [limbs[i] - np.int32(sub[i]) for i in range(len(limbs))]
+    for i in range(len(d) - 1):
+        borrow = (d[i] < 0).astype(jnp.int32)
+        d[i] = d[i] + (borrow << RADIX)
+        d[i + 1] = d[i + 1] - borrow
+    take = d[-1] >= 0
+    return [jnp.where(take, d[i], limbs[i]) for i in range(len(limbs))]
+
+
+def sc_reduce_digits(words: jnp.ndarray) -> jnp.ndarray:
+    """(16, *batch) u32 LE words of a 512-bit integer -> (64, *batch) u32
+    4-bit window digits of (value mod L), LSB window first."""
+    limbs = le32_to_limbs11(words, 47)          # 517 bits ≥ 512
+    x = _fold(limbs)                            # ≤ 35 limbs, |x| < 2^386
+    x = _fold(x)                                # |x| < 2^259
+    if len(x) < NL + 1:
+        x = x + [jnp.zeros_like(x[0])] * (NL + 1 - len(x))
+    x = _fold(x)                                # |x| < 2^253
+    # normalize to [0, L): add 8L, then conditionally subtract 8L,4L,2L,L
+    eightL = _int_to_limbs11(8 * L_INT, 24)
+    if len(x) < 24:
+        x = x + [jnp.zeros_like(x[0])] * (24 - len(x))
+    x = _signed_carry([x[i] + np.int32(eightL[i]) for i in range(24)])
+    for m in (8, 4, 2, 1):
+        x = _cond_sub(x, _L_MULTS[m])
+    return limbs11_to_digits(x)
+
+
+def limbs11_to_digits(limbs: list) -> jnp.ndarray:
+    """23+ canonical limbs (< L) -> (64, *batch) u32 nibble digits."""
+    digs = []
+    for nib in range(64):
+        bit = 4 * nib
+        a, off = bit // RADIX, bit % RADIX
+        v = limbs[a] >> off
+        if off > RADIX - 4 and a + 1 < len(limbs):
+            v = v | (limbs[a + 1] << (RADIX - off))
+        digs.append((v & 15).astype(jnp.uint32))
+    return jnp.stack(digs)

@@ -1,0 +1,189 @@
+"""Batched SHA-512 on device (u32-pair emulation of u64).
+
+The Ed25519 challenge hash h = SHA-512(R || A || M) is ~0.2% of the verify
+kernel's arithmetic, but hashing on the *host* (hashlib loop) costs more
+wall-clock than the whole device kernel at stream batch sizes. Moving the
+hash on-device makes host prep pure byte packing.
+
+TPU has no u64: every 64-bit word is an (hi, lo) pair of uint32 arrays, each
+shaped (*batch,). Carries come from the wraparound compare ``lo_sum < lo_a``
+(exact for two-operand adds). Rotations with static shift counts compile to
+plain vector shifts.
+
+Replaces the host-side hashing half of the reference's hot call
+(crypto/ed25519/ed25519.go:148-155 — Go hashes with crypto/sha512 then calls
+edwards25519); differential tests pin this to hashlib.sha512.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# --- constants -------------------------------------------------------------
+
+_K64 = [
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f, 0xe9b5dba58189dbbc,
+    0x3956c25bf348b538, 0x59f111f1b605d019, 0x923f82a4af194f9b, 0xab1c5ed5da6d8118,
+    0xd807aa98a3030242, 0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235, 0xc19bf174cf692694,
+    0xe49b69c19ef14ad2, 0xefbe4786384f25e3, 0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65,
+    0x2de92c6f592b0275, 0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f, 0xbf597fc7beef0ee4,
+    0xc6e00bf33da88fc2, 0xd5a79147930aa725, 0x06ca6351e003826f, 0x142929670a0e6e70,
+    0x27b70a8546d22ffc, 0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6, 0x92722c851482353b,
+    0xa2bfe8a14cf10364, 0xa81a664bbc423001, 0xc24b8b70d0f89791, 0xc76c51a30654be30,
+    0xd192e819d6ef5218, 0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99, 0x34b0bcb5e19b48a8,
+    0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb, 0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3,
+    0x748f82ee5defb2fc, 0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915, 0xc67178f2e372532b,
+    0xca273eceea26619c, 0xd186b8c721c0c207, 0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178,
+    0x06f067aa72176fba, 0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc, 0x431d67c49c100d4c,
+    0x4cc5d4becb3e42b6, 0x597f299cfc657e2a, 0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+]
+_K_HI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+
+_IV64 = [
+    0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b, 0xa54ff53a5f1d36f1,
+    0x510e527fade682d1, 0x9b05688c2b3e6c1f, 0x1f83d9abfb41bd6b, 0x5be0cd19137e2179,
+]
+
+
+# --- u64-as-u32-pair primitives (static shift counts) -----------------------
+
+def _add64(ah, al, bh, bl):
+    l = al + bl
+    c = (l < al).astype(jnp.uint32)
+    return ah + bh + c, l
+
+
+def _rotr(h, l, n: int):
+    if n == 32:
+        return l, h
+    if n < 32:
+        return ((h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n)))
+    m = n - 32
+    return ((l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m)))
+
+
+def _shr(h, l, n: int):
+    # n < 32 for every SHA-512 use (6 and 7)
+    return h >> n, (l >> n) | (h << (32 - n))
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def _small_sigma0(h, l):
+    return _xor3(_rotr(h, l, 1), _rotr(h, l, 8), _shr(h, l, 7))
+
+
+def _small_sigma1(h, l):
+    return _xor3(_rotr(h, l, 19), _rotr(h, l, 61), _shr(h, l, 6))
+
+
+def _big_sigma0(h, l):
+    return _xor3(_rotr(h, l, 28), _rotr(h, l, 34), _rotr(h, l, 39))
+
+
+def _big_sigma1(h, l):
+    return _xor3(_rotr(h, l, 14), _rotr(h, l, 18), _rotr(h, l, 41))
+
+
+# --- compression -----------------------------------------------------------
+
+def _compress(state, block):
+    """state (8, 2, *batch) u32; block (32, *batch) u32 big-endian words.
+
+    block[2t] / block[2t+1] are the hi/lo halves of message u64 t.
+    """
+    batch_shape = block.shape[1:]
+
+    # message schedule: W (80, 2, *batch), built with a fori_loop
+    w_init = jnp.zeros((80, 2) + batch_shape, dtype=jnp.uint32)
+    w_init = w_init.at[:16, 0].set(block[0::2]).at[:16, 1].set(block[1::2])
+
+    def w_body(t, w):
+        w2 = w[t - 2]
+        w7 = w[t - 7]
+        w15 = w[t - 15]
+        w16 = w[t - 16]
+        s1h, s1l = _small_sigma1(w2[0], w2[1])
+        s0h, s0l = _small_sigma0(w15[0], w15[1])
+        h, l = _add64(s1h, s1l, w7[0], w7[1])
+        h, l = _add64(h, l, s0h, s0l)
+        h, l = _add64(h, l, w16[0], w16[1])
+        return w.at[t, 0].set(h).at[t, 1].set(l)
+
+    w = jax.lax.fori_loop(16, 80, w_body, w_init)
+
+    k_hi = jnp.asarray(_K_HI.reshape((80,) + (1,) * len(batch_shape)))
+    k_lo = jnp.asarray(_K_LO.reshape((80,) + (1,) * len(batch_shape)))
+
+    def round_body(t, vs):
+        ah, al, bh, bl, ch, cl, dh, dl, eh, el, fh, fl, gh, gl, hh, hl = vs
+        s1h, s1l = _big_sigma1(eh, el)
+        chh = (eh & fh) ^ (~eh & gh)
+        chl = (el & fl) ^ (~el & gl)
+        t1h, t1l = _add64(hh, hl, s1h, s1l)
+        t1h, t1l = _add64(t1h, t1l, chh, chl)
+        kh = jax.lax.dynamic_index_in_dim(k_hi, t, 0, keepdims=False)
+        kl = jax.lax.dynamic_index_in_dim(k_lo, t, 0, keepdims=False)
+        t1h, t1l = _add64(t1h, t1l, kh, kl)
+        wt = jax.lax.dynamic_index_in_dim(w, t, 0, keepdims=False)
+        t1h, t1l = _add64(t1h, t1l, wt[0], wt[1])
+        s0h, s0l = _big_sigma0(ah, al)
+        mjh = (ah & bh) ^ (ah & ch) ^ (bh & ch)
+        mjl = (al & bl) ^ (al & cl) ^ (bl & cl)
+        t2h, t2l = _add64(s0h, s0l, mjh, mjl)
+        neh, nel = _add64(dh, dl, t1h, t1l)
+        nah, nal = _add64(t1h, t1l, t2h, t2l)
+        return (nah, nal, ah, al, bh, bl, ch, cl, neh, nel, eh, el, fh, fl, gh, gl)
+
+    init = tuple(state[i, j] for i in range(8) for j in range(2))
+    out = jax.lax.fori_loop(0, 80, round_body, init)
+
+    pairs = []
+    for i in range(8):
+        h, l = _add64(state[i, 0], state[i, 1], out[2 * i], out[2 * i + 1])
+        pairs.append(jnp.stack([h, l]))
+    return jnp.stack(pairs)
+
+
+def sha512_blocks(blocks: jnp.ndarray, nblk: jnp.ndarray) -> jnp.ndarray:
+    """blocks (NBLK, 32, *batch) u32 BE words; nblk (*batch,) — per-lane block
+    count. Lanes with fewer than NBLK blocks freeze their state after their
+    last block. Returns the digest as (8, 2, *batch) u32 (hi, lo) u64 words.
+    """
+    nblocks_static = blocks.shape[0]
+    batch_shape = blocks.shape[2:]
+    iv = np.zeros((8, 2, 1), dtype=np.uint32)
+    for i, v in enumerate(_IV64):
+        iv[i, 0, 0] = v >> 32
+        iv[i, 1, 0] = v & 0xFFFFFFFF
+    state = jnp.broadcast_to(
+        jnp.asarray(iv.reshape((8, 2) + (1,) * len(batch_shape))),
+        (8, 2) + tuple(batch_shape),
+    )
+    for b in range(nblocks_static):
+        new = _compress(state, blocks[b])
+        mask = (jnp.asarray(b, dtype=nblk.dtype) < nblk)
+        state = jnp.where(mask[None, None], new, state)
+    return state
+
+
+def digest_le32(state: jnp.ndarray) -> jnp.ndarray:
+    """(8, 2, *batch) digest words -> (16, *batch) u32 little-endian words.
+
+    The Ed25519 challenge treats the 64 digest *bytes* as a little-endian
+    integer; LE 32-bit word a of that integer is byteswap of BE word a.
+    """
+    x = state.reshape((16,) + state.shape[2:])  # BE word stream hi0,lo0,hi1,..
+    return ((x >> 24) | ((x >> 8) & 0xFF00) | ((x << 8) & 0xFF0000) | (x << 24))

@@ -1,13 +1,20 @@
 """Multi-chip Ed25519 verification plane.
 
-The (msg, sig, pk) batch — laid out ``(17, B, 128)`` limbs / ``(B, 128)``
-flags — is sharded across a 1-D device mesh on the **batch (sublane) axis**
-``B``, never the 128-lane axis: each per-device shard keeps whole
-``(.., 128)`` lane tiles (full vregs), and mesh size is not capped by the
-lane width. Each chip verifies its shard locally, then the tallied voting
-power crosses the mesh with a single ``psum`` over ICI — the distributed
-2/3-majority check that replaces the reference's per-node scalar tally loop
-(reference types/vote_set.go:449, types/validator_set.go:667).
+The packed signature batch — SHA preimage blocks ``(NBLK, 32, B, 128)``,
+block counts ``(B, 128)``, s-words ``(8, B, 128)`` — is sharded across a
+1-D device mesh on the **batch (sublane) axis** ``B``, never the 128-lane
+axis: each per-device shard keeps whole ``(.., 128)`` lane tiles (full
+vregs), and mesh size is not capped by the lane width. Each chip verifies
+its shard locally, then the tallied voting power crosses the mesh with a
+single ``psum`` over ICI — the distributed 2/3-majority check that replaces
+the reference's per-node scalar tally loop (reference
+types/vote_set.go:449, types/validator_set.go:667).
+
+The tally is EXACT for int64 voting powers: each power is split host-side
+into five 15-bit limbs (2^75 > MaxTotalVotingPower = 2^60 headroom), the
+per-limb sums ride the psum as int32 (safe for up to 2^16 signatures
+globally: 2^15 · 2^16 = 2^31), and the host recombines
+``Σ psum_j · 2^15j`` in Python ints.
 """
 
 from __future__ import annotations
@@ -24,8 +31,12 @@ from .verify import LANE, _pad_to, _verify_kernel, pack_device_inputs, prepare_b
 
 AXIS = "sig_batch"
 
-LIMB_SPEC = P(None, AXIS, None)   # (17|64, B, 128): shard the B sublane axis
-FLAG_SPEC = P(AXIS, None)         # (B, 128)
+BLOCK_SPEC = P(None, None, AXIS, None)  # (NBLK, 32, B, 128): shard sublanes
+WORD_SPEC = P(None, AXIS, None)         # (8, B, 128)
+FLAG_SPEC = P(AXIS, None)               # (B, 128)
+
+POWER_LIMBS = 5                          # 5 x 15-bit limbs cover int64 powers
+MAX_EXACT_SIGS = 1 << 16                 # int32-safe limb-sum bound
 
 
 def make_mesh(n_devices: int) -> Mesh:
@@ -40,18 +51,21 @@ def make_mesh(n_devices: int) -> Mesh:
 
 
 def _sharded_step(mesh: Mesh):
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older JAX
+        from jax.experimental.shard_map import shard_map
 
-    def full_step(a_y, a_sign, r_y, r_sign, s_digits, h_digits, powers):
-        verdict = _verify_kernel.__wrapped__(
-            a_y, a_sign, r_y, r_sign, s_digits, h_digits)
-        local_tally = jnp.sum(jnp.where(verdict, powers, 0))
-        total = jax.lax.psum(local_tally, axis_name=AXIS)
-        return verdict, total
+    def full_step(blocks, nblk, s_words, power_limbs):
+        verdict = _verify_kernel.__wrapped__(blocks, nblk, s_words)
+        # (5, B, 128) int32 limb planes; zero out rejected signatures
+        masked = jnp.where(verdict[None], power_limbs, 0)
+        local = jnp.sum(masked, axis=(1, 2))          # (5,) int32
+        total_limbs = jax.lax.psum(local, axis_name=AXIS)
+        return verdict, total_limbs
 
     specs = dict(
-        in_specs=(LIMB_SPEC, FLAG_SPEC, LIMB_SPEC, FLAG_SPEC,
-                  LIMB_SPEC, LIMB_SPEC, FLAG_SPEC),
+        in_specs=(BLOCK_SPEC, FLAG_SPEC, WORD_SPEC, WORD_SPEC),
         out_specs=(FLAG_SPEC, P()),
     )
     try:  # replication checking chokes on scan carries that become varying
@@ -59,6 +73,15 @@ def _sharded_step(mesh: Mesh):
     except TypeError:  # older JAX spells it check_rep
         sharded = shard_map(full_step, mesh=mesh, check_rep=False, **specs)
     return jax.jit(sharded)
+
+
+def _power_limbs(powers: np.ndarray, pad: int, b: int) -> np.ndarray:
+    """(n,) int64 -> (5, B, 128) int32 planes of 15-bit limbs."""
+    out = np.zeros((POWER_LIMBS, pad), dtype=np.int32)
+    p = powers.astype(np.uint64)
+    for j in range(POWER_LIMBS):
+        out[j, : len(powers)] = ((p >> (15 * j)) & 0x7FFF).astype(np.int32)
+    return out.reshape(POWER_LIMBS, b, LANE)
 
 
 def batch_verify_sharded(
@@ -69,37 +92,44 @@ def batch_verify_sharded(
     mesh: Optional[Mesh] = None,
     n_devices: Optional[int] = None,
 ) -> Tuple[np.ndarray, int]:
-    """Verify a batch over a device mesh; -> ((N,) bool verdicts, psum tally).
+    """Verify a batch over a device mesh; -> ((N,) bool verdicts, exact tally).
 
     The batch pads to a multiple of ``n_devices * 128`` so the sublane axis
-    divides evenly across the mesh. The returned tally is the device-side
-    psum of ``powers`` over accepted signatures (int32 — a demo of the
-    collective; exact int64 accounting stays host-side in VoteSet).
+    divides evenly across the mesh. The returned tally is the exact int64
+    sum of ``powers`` over accepted signatures, computed with a device-side
+    psum of 15-bit limb planes (see module docstring).
     """
     if mesh is None:
         mesh = make_mesh(n_devices or len(jax.devices()))
     d = mesh.devices.size
     n = len(pks)
-    pk_arr, r_arr, s_arr, h_arr, ok = prepare_batch(pks, msgs, sigs)
-    pad = max(_pad_to(max(n, 1)), d * LANE)
-    dev_in = pack_device_inputs(pk_arr, r_arr, s_arr, h_arr, pad)
+    if n > MAX_EXACT_SIGS:
+        raise ValueError(
+            f"batch of {n} exceeds the exact-tally bound {MAX_EXACT_SIGS}; "
+            "split into multiple calls"
+        )
+    blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
+    # round up to a multiple of d*LANE so the B axis divides across the mesh
+    unit = d * LANE
+    pad = -(-max(_pad_to(max(n, 1)), unit) // unit) * unit
+    dev_in = pack_device_inputs(blocks_w, nblk, s_words, pad)
     b = pad // LANE
 
-    pw = np.zeros(pad, dtype=np.int32)
+    pw = np.zeros(n, dtype=np.int64)
     if powers is not None:
-        pw[:n] = np.asarray(list(powers), dtype=np.int32)
+        pw[:] = np.asarray(list(powers), dtype=np.int64)
     else:
-        pw[:n] = 1
-    pw[:n] *= ok  # host-invalid entries contribute no power
-    pw = pw.reshape(b, LANE)
+        pw[:] = 1
+    pw *= ok  # host-invalid entries contribute no power
+    limbs = _power_limbs(pw, pad, b)
 
     put = lambda x, spec: jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
     args = (
-        put(dev_in[0], LIMB_SPEC), put(dev_in[1], FLAG_SPEC),
-        put(dev_in[2], LIMB_SPEC), put(dev_in[3], FLAG_SPEC),
-        put(dev_in[4], LIMB_SPEC), put(dev_in[5], LIMB_SPEC),
-        put(pw, FLAG_SPEC),
+        put(dev_in[0], BLOCK_SPEC), put(dev_in[1], FLAG_SPEC),
+        put(dev_in[2], WORD_SPEC), put(limbs, WORD_SPEC),
     )
-    verdict, total = _sharded_step(mesh)(*args)
+    verdict, total_limbs = _sharded_step(mesh)(*args)
     verdict = np.asarray(verdict).reshape(-1)[:n] & ok
-    return verdict, int(total)
+    tl = np.asarray(total_limbs)
+    total = sum(int(tl[j]) << (15 * j) for j in range(POWER_LIMBS))
+    return verdict, total

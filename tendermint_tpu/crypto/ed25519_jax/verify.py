@@ -1,24 +1,37 @@
-"""Batched Ed25519 verification: host prep + one jitted TPU kernel call.
+"""Batched Ed25519 verification: vectorized host packing + on-device
+SHA-512 / scalar reduction / curve arithmetic.
 
 Split of work (SURVEY.md §7 "hard parts"):
 
-* host (numpy/hashlib): length checks, s-canonicality (s < L), the SHA-512
-  challenge hash h = H(R || A || M) mod L (sign-bytes are short; hashing is
-  bandwidth-trivial and hashlib is C-speed), and limb/digit packing;
-* device (jit): point decompression of A, [h](-A) via batched 4-bit windowed
-  double-and-add, [s]B via a precomputed 64x16 niels table, the final
-  encoding, and the byte-equality decision against R.
+* host (numpy, no per-item Python crypto): length checks, the s < L
+  canonicality compare, and packing the SHA-512 preimage blocks
+  (R || A || M, padded) plus the 32-byte s. R and A are recovered *from the
+  first hash block* on device, so per-signature transfer is just the padded
+  preimage + s + a block count (~300 B for vote-sized messages);
+* device (one jitted call): SHA-512 of the preimage (sha512.py), reduction
+  of the 512-bit challenge mod L and window-digit extraction (scalar.py),
+  point decompression of A, [h](-A) via batched 4-bit windowed
+  double-and-add, [s]B via a precomputed 64x16 niels table, and the final
+  encoding/equality decision against R (curve.py).
+
+Two entry points:
+
+* :func:`batch_verify` — one kernel execution, for a single batch;
+* :func:`batch_verify_stream` — a ``lax.scan`` over fixed-size chunks inside
+  ONE execution. Dispatch of a jitted computation has a large fixed cost on
+  remote-attached TPUs (~100 ms through a relay, measured), so sustained
+  throughput requires amortizing it over many chunks per call.
 
 Accept/reject decisions are byte-identical to the host spec
-(tendermint_tpu.crypto.ed25519.verify); differential tests enforce this on
-valid, corrupted, and adversarial inputs.
+(tendermint_tpu.crypto.ed25519.verify, mirroring the reference's Go
+x/crypto hot call at crypto/ed25519/ed25519.go:148-155); differential tests
+enforce this on valid, corrupted, and adversarial inputs.
 """
 
 from __future__ import annotations
 
-import hashlib
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 
@@ -27,15 +40,68 @@ import jax.numpy as jnp
 
 from . import curve
 from . import field as F
+from . import scalar as S
+from . import sha512 as H
 from ..ed25519 import L
-
 
 LANE = 128  # batch is reshaped to (B, 128) so per-limb ops fill (8,128) vregs
 
+# L as 4 little-endian u64 words, for the vectorized s < L compare
+_L_WORDS = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8").copy()
+
+
+def _bswap32(x: jnp.ndarray) -> jnp.ndarray:
+    return (x >> 24) | ((x >> 8) & 0xFF00) | ((x << 8) & 0xFF0000) | (x << 24)
+
+
+def _le32_to_limbs15(words) -> jnp.ndarray:
+    """8 (*batch,) u32 LE words (top bit already stripped) -> (17, *batch)."""
+    out = []
+    for k in range(F.NLIMBS):
+        bit = F.RADIX * k
+        w, off = bit // 32, bit % 32
+        v = words[w] >> off
+        if off > 32 - F.RADIX and w + 1 < 8:
+            v = v | (words[w + 1] << (32 - off))
+        out.append(v & F.MASK)
+    return jnp.stack(out)
+
+
+def _word_nibbles(words: jnp.ndarray) -> jnp.ndarray:
+    """(8, *batch) u32 LE words -> (64, *batch) 4-bit digits, LSB first."""
+    digs = []
+    for nib in range(64):
+        w, off = nib // 8, (nib % 8) * 4
+        digs.append((words[w] >> off) & 15)
+    return jnp.stack(digs)
+
 
 @partial(jax.jit, static_argnums=())
-def _verify_kernel(a_y, a_sign, r_y, r_sign, s_digits, h_digits):
+def _verify_kernel(blocks, nblk, s_words):
+    """blocks (NBLK, 32, *batch) u32 BE sha words of R||A||M padded;
+    nblk (*batch,) i32; s_words (8, *batch) u32 LE. -> (*batch,) bool."""
+    le0 = _bswap32(blocks[0])                    # bytes 0..127 as LE32 words
+    r_words = [le0[i] for i in range(8)]
+    a_words = [le0[8 + i] for i in range(8)]
+    a_sign = a_words[7] >> 31
+    r_sign = r_words[7] >> 31
+    a_words[7] = a_words[7] & 0x7FFFFFFF
+    r_words[7] = r_words[7] & 0x7FFFFFFF
+    a_y = _le32_to_limbs15(a_words)
+    r_y = _le32_to_limbs15(r_words)
+
+    digest = H.sha512_blocks(blocks, nblk)
+    h_digits = S.sc_reduce_digits(H.digest_le32(digest))
+    s_digits = _word_nibbles(s_words)
+
     A, ok_a = curve.decompress(a_y, a_sign)
+    # failed decompressions leave garbage coordinates that are not on the
+    # curve, where the complete addition law's z != 0 guarantee (and hence
+    # encode's batch-inversion precondition) does not hold — mask them to the
+    # identity; their verdict is already forced false by ok_a.
+    ident = curve.identity(a_y.shape[1:])
+    A = curve.Point(*(jnp.where(ok_a[None], c, ic)
+                      for c, ic in zip(A, ident)))
     h_negA = curve.scalar_mul_windowed(curve.neg(A), h_digits)
     sB = curve.scalar_mul_base(s_digits)
     rprime = curve.add(sB, h_negA)
@@ -44,11 +110,17 @@ def _verify_kernel(a_y, a_sign, r_y, r_sign, s_digits, h_digits):
     return ok_a & eq_r
 
 
-def _nibbles(b: np.ndarray) -> np.ndarray:
-    """(N, 32) le bytes -> (64, N) 4-bit window digits, LSB window first."""
-    out = np.zeros((64, b.shape[0]), dtype=np.uint32)
-    out[0::2] = (b & 0x0F).T
-    out[1::2] = (b >> 4).T
+@partial(jax.jit, static_argnums=())
+def _verify_stream_kernel(blocks, nblk, s_words):
+    """Scan the verify kernel over K chunks in one execution.
+
+    blocks (K, NBLK, 32, B, 128), nblk (K, B, 128), s_words (K, 8, B, 128).
+    """
+    def step(_, x):
+        b, n, s = x
+        return None, _verify_kernel.__wrapped__(b, n, s)
+
+    _, out = jax.lax.scan(step, None, (blocks, nblk, s_words))
     return out
 
 
@@ -63,60 +135,118 @@ def _pad_to(n: int) -> int:
 
 def prepare_batch(
     pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
-) -> Tuple[np.ndarray, ...]:
-    """Pack (pk, msg, sig) tuples into device-ready arrays + host validity mask."""
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pack (pk, msg, sig) tuples into kernel inputs + host validity mask.
+
+    Returns (blocks (N, NBLK, 32) u32 BE, nblk (N,) i32, s_words (N, 8) u32,
+    ok (N,) bool). All numpy, vectorized except cheap per-item length/bytes
+    plumbing.
+    """
     if not (len(pks) == len(msgs) == len(sigs)):
         raise ValueError(
             f"batch length mismatch: {len(pks)} pks, {len(msgs)} msgs, {len(sigs)} sigs"
         )
     n = len(pks)
-    ok = np.ones(n, dtype=bool)
-    pk_arr = np.zeros((n, 32), dtype=np.uint8)
-    r_arr = np.zeros((n, 32), dtype=np.uint8)
-    s_arr = np.zeros((n, 32), dtype=np.uint8)
-    h_arr = np.zeros((n, 32), dtype=np.uint8)
-    for i, (pk, msg, sig) in enumerate(zip(pks, msgs, sigs)):
-        if len(pk) != 32 or len(sig) != 64:
-            ok[i] = False
-            continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:
-            ok[i] = False
-            continue
-        pk_arr[i] = np.frombuffer(pk, dtype=np.uint8)
-        r_arr[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-        s_arr[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-        h = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
-        h_arr[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
-    return pk_arr, r_arr, s_arr, h_arr, ok
+    if n == 0:
+        return (np.zeros((0, 1, 32), np.uint32), np.zeros(0, np.int32),
+                np.zeros((0, 8), np.uint32), np.zeros(0, bool))
+    pk_lens = np.fromiter((len(p) for p in pks), dtype=np.int64, count=n)
+    sig_lens = np.fromiter((len(s) for s in sigs), dtype=np.int64, count=n)
+    ok = (pk_lens == 32) & (sig_lens == 64)
+    if ok.all():
+        pk_l, sig_l = pks, sigs
+    else:
+        zpk, zsig = b"\x00" * 32, b"\x00" * 64
+        pk_l = [pk if o else zpk for pk, o in zip(pks, ok)]
+        sig_l = [sg if o else zsig for sg, o in zip(sigs, ok)]
+    sig_arr = np.frombuffer(b"".join(sig_l), dtype=np.uint8).reshape(n, 64)
+    r_arr = sig_arr[:, :32]
+    s_arr = np.ascontiguousarray(sig_arr[:, 32:])
+    pk_arr = np.frombuffer(b"".join(pk_l), dtype=np.uint8).reshape(n, 32)
+
+    # s < L, vectorized lexicographic compare on LE u64 words (most
+    # significant word first)
+    s64 = s_arr.view("<u8")                      # (n, 4)
+    lt = np.zeros(n, dtype=bool)
+    decided = np.zeros(n, dtype=bool)
+    for w in (3, 2, 1, 0):
+        lw = _L_WORDS[w]
+        lt |= ~decided & (s64[:, w] < lw)
+        decided |= s64[:, w] != lw
+    ok &= lt
+
+    # SHA-512 preimage blocks: R || A || M || 0x80 pad || 128-bit BE bitlen
+    mlens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
+    nblk = ((64 + mlens + 17 + 127) // 128).astype(np.int32)
+    nblk_max = int(nblk.max())
+    blocks = np.zeros((n, nblk_max * 128), dtype=np.uint8)
+    blocks[:, :32] = r_arr
+    blocks[:, 32:64] = pk_arr
+    if n and mlens.max() == mlens.min():
+        ml = int(mlens[0])
+        if ml:
+            blocks[:, 64:64 + ml] = np.frombuffer(
+                b"".join(msgs), dtype=np.uint8).reshape(n, ml)
+    elif int(mlens.sum()):
+        # vectorized ragged scatter: flat destination index for every
+        # message byte, built from cumulative offsets
+        flat_src = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+        starts = np.zeros(n, dtype=np.int64)
+        np.cumsum(mlens[:-1], out=starts[1:])
+        width = blocks.shape[1]
+        within = np.arange(flat_src.shape[0], dtype=np.int64) - np.repeat(starts, mlens)
+        dst = np.repeat(np.arange(n, dtype=np.int64) * width + 64, mlens) + within
+        blocks.reshape(-1)[dst] = flat_src
+    rows = np.arange(n)
+    blocks[rows, 64 + mlens] = 0x80
+    bitlen = ((64 + mlens) * 8).astype(np.uint64)
+    last = nblk.astype(np.int64) * 128
+    for k in range(8):
+        blocks[rows, last - 1 - k] = ((bitlen >> (8 * k)) & 0xFF).astype(np.uint8)
+
+    # big-endian u32 view + native cast = one vectorized byteswap pass
+    blocks_w = blocks.view(">u4").astype(np.uint32).reshape(n, nblk_max, 32)
+    s_words = np.ascontiguousarray(s_arr).view("<u4").astype(np.uint32)  # (n, 8)
+    return blocks_w, nblk, s_words, ok
 
 
-def pack_device_inputs(pk_arr, r_arr, s_arr, h_arr, pad: int):
-    """numpy byte arrays -> padded device inputs shaped (.., B, 128).
+def pack_device_inputs(blocks_w, nblk, s_words, pad: int):
+    """(n, ...) numpy arrays -> padded device inputs shaped (.., B, 128).
 
     The 2-D batch layout puts 128 items on the lane axis and B = pad/128 on
     sublanes, so every per-limb (1, B, 128) slice occupies whole vregs.
     """
-    n = pk_arr.shape[0]
+    n = blocks_w.shape[0]
+    nblk_max = blocks_w.shape[1]
     if pad > n:
-        z = lambda a: np.pad(a, ((0, pad - n), (0, 0)))
-        pk_arr, r_arr, s_arr, h_arr = z(pk_arr), z(r_arr), z(s_arr), z(h_arr)
+        blocks_w = np.pad(blocks_w, ((0, pad - n), (0, 0), (0, 0)))
+        nblk = np.pad(nblk, (0, pad - n))
+        s_words = np.pad(s_words, ((0, pad - n), (0, 0)))
     b = pad // LANE
-    a_sign = (pk_arr[:, 31] >> 7).astype(np.uint32).reshape(b, LANE)
-    r_sign = (r_arr[:, 31] >> 7).astype(np.uint32).reshape(b, LANE)
-    pk_m = pk_arr.copy()
-    pk_m[:, 31] &= 0x7F
-    r_m = r_arr.copy()
-    r_m[:, 31] &= 0x7F
-    shape3 = (F.NLIMBS, b, LANE)
     return (
-        F.bytes_to_limbs(pk_m).reshape(shape3),
-        a_sign,
-        F.bytes_to_limbs(r_m).reshape(shape3),
-        r_sign,
-        _nibbles(s_arr).reshape(64, b, LANE),
-        _nibbles(h_arr).reshape(64, b, LANE),
+        np.ascontiguousarray(blocks_w.transpose(1, 2, 0)).reshape(nblk_max, 32, b, LANE),
+        nblk.reshape(b, LANE),
+        np.ascontiguousarray(s_words.T).reshape(8, b, LANE),
     )
+
+
+def _nblk_bucket(mlen: int) -> int:
+    """Per-item padded SHA block count, rounded up to a power of two — the
+    bucket key for grouping. Grouping bounds both memory (one long message
+    must not inflate every row of the (n, NBLK*128) preimage buffer) and
+    kernel recompiles (shapes quantize to power-of-two NBLK)."""
+    nblk = (64 + mlen + 17 + 127) // 128
+    b = 1
+    while b < nblk:
+        b *= 2
+    return b
+
+
+def _group_by_bucket(msgs: Sequence[bytes]):
+    groups: dict = {}
+    for i, m in enumerate(msgs):
+        groups.setdefault(_nblk_bucket(len(m)), []).append(i)
+    return groups
 
 
 def batch_verify(
@@ -126,7 +256,62 @@ def batch_verify(
     n = len(pks)
     if n == 0:
         return np.zeros(0, dtype=bool)
-    pk_arr, r_arr, s_arr, h_arr, ok = prepare_batch(pks, msgs, sigs)
-    dev_in = pack_device_inputs(pk_arr, r_arr, s_arr, h_arr, _pad_to(n))
+    groups = _group_by_bucket(msgs)
+    if len(groups) > 1:
+        out = np.zeros(n, dtype=bool)
+        for idxs in groups.values():
+            out[idxs] = batch_verify([pks[i] for i in idxs],
+                                     [msgs[i] for i in idxs],
+                                     [sigs[i] for i in idxs])
+        return out
+    blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
+    bucket = next(iter(groups))
+    if blocks_w.shape[1] < bucket:  # pad NBLK up to the bucket size
+        blocks_w = np.pad(blocks_w, ((0, 0), (0, bucket - blocks_w.shape[1]), (0, 0)))
+    dev_in = pack_device_inputs(blocks_w, nblk, s_words, _pad_to(n))
     verdict = np.asarray(_verify_kernel(*dev_in)).reshape(-1)[:n]
     return verdict & ok
+
+
+def batch_verify_stream(
+    pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes],
+    chunk: int = 1024,
+) -> np.ndarray:
+    """(N,) bool — verify a large batch as K chunks scanned inside ONE
+    device execution (amortizes per-dispatch overhead)."""
+    n = len(pks)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    if chunk % LANE:
+        raise ValueError(f"chunk must be a multiple of {LANE}")
+    if n <= chunk:
+        return batch_verify(pks, msgs, sigs)
+    groups = _group_by_bucket(msgs)
+    if len(groups) > 1:  # see _nblk_bucket: memory + recompile bound
+        out = np.zeros(n, dtype=bool)
+        for idxs in groups.values():
+            out[idxs] = batch_verify_stream([pks[i] for i in idxs],
+                                            [msgs[i] for i in idxs],
+                                            [sigs[i] for i in idxs], chunk)
+        return out
+    blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
+    bucket = next(iter(groups))
+    if blocks_w.shape[1] < bucket:
+        blocks_w = np.pad(blocks_w, ((0, 0), (0, bucket - blocks_w.shape[1]), (0, 0)))
+    k = -(-n // chunk)
+    pad = k * chunk
+    nblk_max = blocks_w.shape[1]
+    if pad > n:
+        blocks_w = np.pad(blocks_w, ((0, pad - n), (0, 0), (0, 0)))
+        nblk = np.pad(nblk, (0, pad - n))
+        s_words = np.pad(s_words, ((0, pad - n), (0, 0)))
+    b = chunk // LANE
+    blocks_d = np.ascontiguousarray(
+        blocks_w.reshape(k, chunk, nblk_max, 32).transpose(0, 2, 3, 1)
+    ).reshape(k, nblk_max, 32, b, LANE)
+    nblk_d = nblk.reshape(k, b, LANE)
+    s_d = np.ascontiguousarray(
+        s_words.reshape(k, chunk, 8).transpose(0, 2, 1)
+    ).reshape(k, 8, b, LANE)
+    verdict = np.asarray(_verify_stream_kernel(blocks_d, nblk_d, s_d))
+    return verdict.reshape(-1)[:n] & ok

@@ -1,0 +1,161 @@
+"""Micro-batched verification for the streaming vote path (HOT LOOP #1).
+
+The reference's hottest call site is one scalar ed25519 verify per gossiped
+vote (types/vote_set.go:205 → vote.go:147). Votes arrive concurrently from
+many peer tasks but are *consumed* by the single-writer consensus loop —
+verifying inside that loop serializes everything, so batching must happen
+in front of it:
+
+* per-peer reactor tasks call :meth:`preverify` BEFORE enqueueing the vote
+  to the state machine. Pre-verifications accumulate across peers; a flush
+  fires when ``max_batch`` is reached or ``deadline_s`` after the first
+  pending item (SURVEY.md §7: deadline micro-batching with host fallback);
+* a flush below ``min_device_batch`` verifies on the host scalar path (a
+  device call would cost more than it saves at low rate); above it, ONE
+  batched device call covers every pending vote;
+* verdicts land in a one-shot cache keyed by (pubkey, msg, sig). When the
+  single-writer loop later reaches ``VoteSet.add_vote`` →
+  :meth:`verify_vote`, the lookup hits and no signature work happens on the
+  hot loop at all. A miss (catchup votes, adversarial replays, no reactor)
+  falls back to the host scalar verify — correctness NEVER depends on
+  pre-verification, and accept/reject stays byte-identical to the spec.
+
+``stats`` counts device/host/cache traffic so tests can assert the device
+path is provably taken.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("tmtpu.votebatch")
+
+# at/above this many pending sigs a flush goes to the device; below, host
+DEFAULT_MIN_DEVICE_BATCH = 16
+DEFAULT_MAX_BATCH = 1024
+DEFAULT_DEADLINE_S = 0.003
+_CACHE_CAP = 16384
+
+
+class BatchVoteVerifier:
+    """Shared by the consensus reactor (preverify) and VoteSet (verify)."""
+
+    def __init__(self, min_device_batch: int = DEFAULT_MIN_DEVICE_BATCH,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 deadline_s: float = DEFAULT_DEADLINE_S):
+        self.min_device_batch = min_device_batch
+        self.max_batch = max_batch
+        self.deadline_s = deadline_s
+        self._pending: List[Tuple[bytes, bytes, bytes, bytes, asyncio.Future]] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        # strong refs to in-flight flush tasks (event loop keeps only weak
+        # refs; a GC'd flush would strand every pending preverify future)
+        self._flush_tasks: set = set()
+        self._cache: "collections.OrderedDict[bytes, bool]" = collections.OrderedDict()
+        self.stats = collections.Counter()
+
+    # -- sync side (VoteSet.add_vote, single-writer loop) --------------------
+
+    def verify(self, pub, msg: bytes, sig: bytes) -> bool:
+        """Byte-identical to pub.verify_signature; consumes a cached verdict
+        when the reactor already pre-verified this exact (pk, msg, sig)."""
+        key = self._key(pub.bytes(), msg, sig)
+        hit = self._cache.pop(key, None)
+        if hit is not None:
+            self.stats["cache_hits"] += 1
+            return hit
+        self.stats["sync_host_sigs"] += 1
+        return pub.verify_signature(msg, sig)
+
+    # -- async side (reactor per-peer tasks) ---------------------------------
+
+    async def preverify(self, pub, msg: bytes, sig: bytes) -> bool:
+        """Micro-batched verification; resolves when this item's batch does."""
+        from . import Ed25519PubKey
+
+        if not isinstance(pub, Ed25519PubKey):
+            # rare key types never ride the ed25519 kernel (and must not
+            # poison the cache with a wrong-scheme verdict)
+            self.stats["non_ed25519"] += 1
+            return pub.verify_signature(msg, sig)
+        pk = pub.bytes()
+        key = self._key(pk, msg, sig)
+        cached = self._cache.get(key)
+        if cached is not None:
+            self.stats["cache_hits_pre"] += 1
+            return cached
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((key, pk, msg, sig, fut))
+        if len(self._pending) >= self.max_batch:
+            self._do_flush()
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(self.deadline_s, self._do_flush)
+        return await fut
+
+    def _do_flush(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        batch = self._pending
+        self._pending = []
+        if not batch:
+            return
+        t = asyncio.ensure_future(self._run_flush(batch))
+        self._flush_tasks.add(t)
+        t.add_done_callback(self._flush_tasks.discard)
+
+    async def _run_flush(self, batch) -> None:
+        from . import Ed25519PubKey
+
+        n = len(batch)
+        try:
+            if n >= self.min_device_batch:
+                from .ed25519_jax import batch_verify_stream
+
+                pks = [b[1] for b in batch]
+                msgs = [b[2] for b in batch]
+                sigs = [b[3] for b in batch]
+                loop = asyncio.get_running_loop()
+                out = await loop.run_in_executor(
+                    None, batch_verify_stream, pks, msgs, sigs)
+                self.stats["device_batches"] += 1
+                self.stats["device_sigs"] += n
+                results = [bool(v) for v in out]
+            else:
+                self.stats["host_batches"] += 1
+                self.stats["host_sigs"] += n
+
+                def _host_verify():
+                    return [Ed25519PubKey(pk).verify_signature(m, s)
+                            for _key, pk, m, s, _fut in batch]
+
+                # off the event loop: even a sub-threshold flush shouldn't
+                # stall peers/timers for ~ms of OpenSSL work
+                loop = asyncio.get_running_loop()
+                results = await loop.run_in_executor(None, _host_verify)
+        except Exception as e:  # pragma: no cover - defensive
+            logger.exception("vote batch flush failed: %s", e)
+            for _, _, _, _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (key, _pk, _m, _s, fut), ok in zip(batch, results):
+            self._cache[key] = ok
+            self._cache.move_to_end(key)
+            if not fut.done():
+                fut.set_result(ok)
+        while len(self._cache) > _CACHE_CAP:
+            self._cache.popitem(last=False)
+
+    async def flush_now(self) -> None:
+        """Force a flush (tests / shutdown)."""
+        self._do_flush()
+        await asyncio.sleep(0)
+
+    @staticmethod
+    def _key(pk: bytes, msg: bytes, sig: bytes) -> bytes:
+        return b"%d|" % len(pk) + pk + b"|%d|" % len(msg) + msg + sig

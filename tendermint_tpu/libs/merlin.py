@@ -1,0 +1,165 @@
+"""Merlin transcripts over STROBE-128 (keccak-f[1600]).
+
+Used by the p2p SecretConnection handshake to bind the STS transcript
+(reference p2p/conn/secret_connection.go:92 uses github.com/gtank/merlin).
+Implements exactly the subset Merlin needs from STROBE v1.0.2: meta-AD, AD,
+PRF (merlin-rust's strobe.rs mini-STROBE), plus the transcript framing
+(``dom-sep`` / LE32 length prefixes).
+
+Pure Python; handshake-time only (a few permutations per connection), so
+speed is irrelevant. Determinism and self-consistency are unit-tested;
+cross-implementation vectors could not be fetched in this offline build —
+if byte-compatibility with gtank/merlin is ever required, validate against
+the merlin test suite first.
+"""
+
+from __future__ import annotations
+
+# --- keccak-f[1600] ---------------------------------------------------------
+
+_ROUNDS = 24
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+_MASK = (1 << 64) - 1
+
+
+def _rol(x: int, n: int) -> int:
+    n %= 64
+    return ((x << n) | (x >> (64 - n))) & _MASK
+
+
+def keccak_f1600(state: bytearray) -> None:
+    """In-place permutation of a 200-byte state (little-endian lanes)."""
+    a = [[int.from_bytes(state[8 * (x + 5 * y):8 * (x + 5 * y) + 8], "little")
+          for y in range(5)] for x in range(5)]
+    for rnd in range(_ROUNDS):
+        # theta
+        c = [a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rol(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x][y] ^= d[x]
+        # rho + pi
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rol(a[x][y], _ROT[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                a[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y] & _MASK)
+        # iota
+        a[0][0] ^= _RC[rnd]
+    for x in range(5):
+        for y in range(5):
+            state[8 * (x + 5 * y):8 * (x + 5 * y) + 8] = a[x][y].to_bytes(8, "little")
+
+
+# --- mini-STROBE-128 (merlin-rust strobe.rs subset) -------------------------
+
+_FLAG_I = 1
+_FLAG_A = 1 << 1
+_FLAG_C = 1 << 2
+_FLAG_T = 1 << 3
+_FLAG_M = 1 << 4
+_FLAG_K = 1 << 5
+
+_RATE = 166  # 200 - 128/4 - 2
+
+
+class Strobe128:
+    def __init__(self, protocol_label: bytes):
+        self._st = bytearray(200)
+        self._st[0:6] = bytes([1, _RATE + 2, 1, 0, 1, 96])
+        self._st[6:18] = b"STROBEv1.0.2"
+        keccak_f1600(self._st)
+        self._pos = 0
+        self._pos_begin = 0
+        self._cur_flags = 0
+        self.meta_ad(protocol_label, more=False)
+
+    def _run_f(self) -> None:
+        self._st[self._pos] ^= self._pos_begin
+        self._st[self._pos + 1] ^= 0x04
+        self._st[_RATE + 1] ^= 0x80
+        keccak_f1600(self._st)
+        self._pos = 0
+        self._pos_begin = 0
+
+    def _absorb(self, data: bytes) -> None:
+        for byte in data:
+            self._st[self._pos] ^= byte
+            self._pos += 1
+            if self._pos == _RATE:
+                self._run_f()
+
+    def _squeeze(self, n: int) -> bytes:
+        out = bytearray(n)
+        for i in range(n):
+            out[i] = self._st[self._pos]
+            self._st[self._pos] = 0
+            self._pos += 1
+            if self._pos == _RATE:
+                self._run_f()
+        return bytes(out)
+
+    def _begin_op(self, flags: int) -> None:
+        assert not flags & _FLAG_T, "mini-STROBE has no transport ops"
+        old_begin = self._pos_begin
+        self._pos_begin = self._pos + 1
+        self._cur_flags = flags
+        self._absorb(bytes([old_begin, flags]))
+        if flags & (_FLAG_C | _FLAG_K) and self._pos != 0:
+            self._run_f()
+
+    def meta_ad(self, data: bytes, more: bool) -> None:
+        if not more:
+            self._begin_op(_FLAG_M | _FLAG_A)
+        self._absorb(data)
+
+    def ad(self, data: bytes, more: bool) -> None:
+        if not more:
+            self._begin_op(_FLAG_A)
+        self._absorb(data)
+
+    def prf(self, n: int, more: bool = False) -> bytes:
+        if not more:
+            self._begin_op(_FLAG_I | _FLAG_A | _FLAG_C)
+        return self._squeeze(n)
+
+
+# --- Merlin transcript ------------------------------------------------------
+
+def _le32(n: int) -> bytes:
+    return n.to_bytes(4, "little")
+
+
+class Transcript:
+    """merlin::Transcript equivalent (append_message / challenge_bytes)."""
+
+    def __init__(self, label: bytes):
+        self._strobe = Strobe128(b"Merlin v1.0")
+        self.append_message(b"dom-sep", label)
+
+    def append_message(self, label: bytes, message: bytes) -> None:
+        self._strobe.meta_ad(label, more=False)
+        self._strobe.meta_ad(_le32(len(message)), more=True)
+        self._strobe.ad(message, more=False)
+
+    def challenge_bytes(self, label: bytes, n: int) -> bytes:
+        self._strobe.meta_ad(label, more=False)
+        self._strobe.meta_ad(_le32(n), more=True)
+        return self._strobe.prf(n)

@@ -9,6 +9,10 @@ authenticated multiplexed transport (SecretConnection + MConnection).
 from .base import ChannelDescriptor, Envelope, Peer, Reactor  # noqa: F401
 from .switch import Switch  # noqa: F401
 from .inproc import InProcNetwork  # noqa: F401
+from .key import NodeKey, pubkey_to_id  # noqa: F401
+from .netaddress import NetAddress, parse_peer_list  # noqa: F401
+from .node_info import NodeInfo  # noqa: F401
+from .transport import TCPTransport  # noqa: F401
 
 # Channel IDs (reference consensus/reactor.go:26-29, mempool/mempool.go:14,
 # evidence/reactor.go:16, blockchain/v0/reactor.go, statesync/reactor.go:22)

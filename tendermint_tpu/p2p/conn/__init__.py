@@ -1,0 +1,5 @@
+"""Authenticated encrypted transport + channel multiplexing
+(reference p2p/conn/: secret_connection.go, connection.go)."""
+
+from .secret_connection import SecretConnection  # noqa: F401
+from .mconnection import MConnection, MConnConfig  # noqa: F401

@@ -1,0 +1,270 @@
+"""MConnection: multiplexes N logical channels over one SecretConnection
+(reference p2p/conn/connection.go:78).
+
+Wire format mirrors the reference: length-delimited protobuf ``Packet``
+oneof — PacketPing(field 1), PacketPong(field 2), PacketMsg(field 3:
+channel_id=1, eof=2, data=3) — with messages split into packets of
+``max_packet_msg_payload_size`` bytes (connection.go:27-34).
+
+Scheduling mirrors sendSomePacketMsgs/sendPacketMsg (connection.go:504,520):
+the next packet comes from the channel with the least
+``recently_sent / priority`` ratio, with recently_sent decayed every flush.
+Rate limiting is a token bucket over sealed bytes (libs/flowrate analog);
+ping/pong keepalive with a pong timeout tears the connection down.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ...libs import protowire as pw
+from ..base import ChannelDescriptor
+
+logger = logging.getLogger("tmtpu.p2p.mconn")
+
+
+@dataclass
+class MConnConfig:
+    """(connection.go:122 MConnConfig)"""
+
+    send_rate: int = 5_120_000          # bytes/s
+    recv_rate: int = 5_120_000
+    max_packet_msg_payload_size: int = 1024
+    flush_throttle: float = 0.1
+    ping_interval: float = 60.0
+    pong_timeout: float = 45.0
+
+
+def _encode_packet_msg(channel_id: int, eof: bool, data: bytes) -> bytes:
+    inner = pw.Writer()
+    inner.varint(1, channel_id)
+    if eof:
+        inner.bool(2, True)
+    if data:
+        inner.bytes(3, data)
+    w = pw.Writer()
+    w.message(3, inner.finish())
+    return pw.length_delimited(w.finish())
+
+
+def _encode_ping() -> bytes:
+    w = pw.Writer()
+    w.message(1, b"")
+    return pw.length_delimited(w.finish())
+
+
+def _encode_pong() -> bytes:
+    w = pw.Writer()
+    w.message(2, b"")
+    return pw.length_delimited(w.finish())
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor, max_payload: int):
+        self.desc = desc
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, desc.send_queue_capacity))
+        self.sending: bytes = b""
+        self.recently_sent = 0
+        self.recving = b""
+        self.max_payload = max_payload
+
+    def next_packet(self) -> Optional[bytes]:
+        """The next PacketMsg for this channel, or None if idle."""
+        if not self.sending:
+            if self.queue.empty():
+                return None
+            self.sending = self.queue.get_nowait()
+        chunk = self.sending[: self.max_payload]
+        rest = self.sending[self.max_payload:]
+        self.sending = rest
+        eof = not rest
+        self.recently_sent += len(chunk)
+        return _encode_packet_msg(self.desc.id, eof, chunk)
+
+    def has_data(self) -> bool:
+        return bool(self.sending) or not self.queue.empty()
+
+
+class MConnection:
+    def __init__(self, conn, chan_descs: List[ChannelDescriptor],
+                 on_receive: Callable[[int, bytes], Awaitable[None]],
+                 on_error: Callable[[Exception], Awaitable[None]],
+                 config: Optional[MConnConfig] = None):
+        self.conn = conn  # SecretConnection or any object with read()/write()
+        self.config = config or MConnConfig()
+        self.channels: Dict[int, _Channel] = {
+            d.id: _Channel(d, self.config.max_packet_msg_payload_size)
+            for d in chan_descs
+        }
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self._send_task: Optional[asyncio.Task] = None
+        self._recv_task: Optional[asyncio.Task] = None
+        self._ping_task: Optional[asyncio.Task] = None
+        self._send_event = asyncio.Event()
+        self._pong_pending = False
+        self._pong_deadline = 0.0
+        self._raw_sends: set = set()
+        self._send_budget = float(self.config.send_rate)
+        self._budget_at = time.monotonic()
+        self._stopped = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._send_task = asyncio.create_task(self._send_routine())
+        self._recv_task = asyncio.create_task(self._recv_routine())
+        self._ping_task = asyncio.create_task(self._ping_routine())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in (self._send_task, self._recv_task, self._ping_task):
+            if t is not None:
+                t.cancel()
+        for t in (self._send_task, self._recv_task, self._ping_task):
+            if t is not None:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        if hasattr(self.conn, "close"):
+            self.conn.close()
+
+    # -- sending -------------------------------------------------------------
+
+    async def send(self, channel_id: int, msg: bytes, timeout: float = 10.0) -> bool:
+        """Blocking send with the reference's 10s default timeout."""
+        ch = self.channels.get(channel_id)
+        if ch is None or self._stopped:
+            return False
+        try:
+            await asyncio.wait_for(ch.queue.put(msg), timeout)
+        except asyncio.TimeoutError:
+            return False
+        self._send_event.set()
+        return True
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        ch = self.channels.get(channel_id)
+        if ch is None or self._stopped:
+            return False
+        try:
+            ch.queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_event.set()
+        return True
+
+    def _pick_channel(self) -> Optional[_Channel]:
+        """Least recently_sent/priority wins (connection.go:520)."""
+        best, best_ratio = None, None
+        for ch in self.channels.values():
+            if not ch.has_data():
+                continue
+            ratio = ch.recently_sent / max(1, ch.desc.priority)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _throttle(self, nbytes: int) -> None:
+        """Token-bucket send pacing (libs/flowrate analog)."""
+        now = time.monotonic()
+        self._send_budget = min(
+            float(self.config.send_rate),
+            self._send_budget + (now - self._budget_at) * self.config.send_rate)
+        self._budget_at = now
+        self._send_budget -= nbytes
+        if self._send_budget < 0:
+            await asyncio.sleep(-self._send_budget / self.config.send_rate)
+
+    async def _send_routine(self) -> None:
+        try:
+            while not self._stopped:
+                ch = self._pick_channel()
+                if ch is None:
+                    self._send_event.clear()
+                    # decay counters while idle (connection.go flush)
+                    for c in self.channels.values():
+                        c.recently_sent = int(c.recently_sent * 0.8)
+                    try:
+                        await asyncio.wait_for(self._send_event.wait(),
+                                               self.config.flush_throttle)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                pkt = ch.next_packet()
+                if pkt is None:
+                    continue
+                await self._throttle(len(pkt))
+                await self.conn.write(pkt)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if not self._stopped:
+                await self.on_error(e)
+
+    # -- receiving -----------------------------------------------------------
+
+    async def _recv_routine(self) -> None:
+        try:
+            while not self._stopped:
+                msg = await self.conn.read_msg()
+                ln, pos = pw.decode_varint(msg, 0)
+                body = msg[pos:pos + ln]
+                fields = pw.fields_dict(body)
+                if 1 in fields:  # PacketPing
+                    self.try_send_raw(_encode_pong())
+                elif 2 in fields:  # PacketPong
+                    self._pong_pending = False
+                elif 3 in fields:  # PacketMsg
+                    pkt = pw.fields_dict(fields[3][0])
+                    ch_id = pkt.get(1, [0])[0]
+                    eof = bool(pkt.get(2, [0])[0])
+                    data = pkt.get(3, [b""])[0]
+                    ch = self.channels.get(ch_id)
+                    if ch is None:
+                        raise RuntimeError(f"unknown channel {ch_id:#x}")
+                    ch.recving += data
+                    if len(ch.recving) > ch.desc.recv_message_capacity:
+                        raise RuntimeError(
+                            f"recv msg exceeds capacity on {ch_id:#x}")
+                    if eof:
+                        complete, ch.recving = ch.recving, b""
+                        await self.on_receive(ch_id, complete)
+                else:
+                    raise RuntimeError("unknown packet type")
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError) as e:
+            if not self._stopped:
+                await self.on_error(e)
+        except Exception as e:
+            if not self._stopped:
+                await self.on_error(e)
+
+    def try_send_raw(self, framed: bytes) -> None:
+        t = asyncio.ensure_future(self.conn.write(framed))
+        self._raw_sends.add(t)
+        t.add_done_callback(self._raw_sends.discard)
+
+    # -- keepalive -----------------------------------------------------------
+
+    async def _ping_routine(self) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.config.ping_interval)
+                self._pong_pending = True
+                await self.conn.write(_encode_ping())
+                await asyncio.sleep(self.config.pong_timeout)
+                if self._pong_pending and not self._stopped:
+                    await self.on_error(RuntimeError("pong timeout"))
+                    return
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if not self._stopped:
+                await self.on_error(e)

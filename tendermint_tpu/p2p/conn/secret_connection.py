@@ -1,0 +1,243 @@
+"""SecretConnection: the STS (station-to-station) authenticated-encryption
+transport (reference p2p/conn/secret_connection.go:55,92).
+
+Protocol (byte-layout faithful to the reference):
+
+1. exchange ephemeral X25519 pubkeys, each as a length-delimited protobuf
+   ``BytesValue`` (secret_connection.go:307);
+2. sort the two pubkeys; bind ``EPHEMERAL_LOWER_PUBLIC_KEY``,
+   ``EPHEMERAL_UPPER_PUBLIC_KEY`` and the X25519 shared secret into a
+   Merlin transcript ``TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH``
+   (libs/merlin.py — STROBE-128, matches the upstream merlin test vector);
+3. derive two ChaCha20-Poly1305 keys with HKDF-SHA256
+   (info ``TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN``; key order
+   decided by which ephemeral key sorts lower, secret_connection.go:337);
+4. extract the 32-byte challenge ``SECRET_CONNECTION_MAC`` from the
+   transcript; each side signs it with its long-lived ed25519 node key and
+   sends ``AuthSigMessage{pub_key, sig}`` over the now-encrypted channel;
+5. data flows in sealed frames of 1028 bytes (4-byte LE chunk length +
+   1024 data) + 16-byte Poly1305 tag, nonce = 4 zero bytes + 8-byte LE
+   counter (secret_connection.go:36-41,455).
+
+asyncio StreamReader/StreamWriter based.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+from ...crypto import Ed25519PubKey, PrivKey, PubKey
+from ...libs.merlin import Transcript
+from ...libs import protowire as pw
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+AEAD_SIZE_OVERHEAD = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + AEAD_SIZE_OVERHEAD
+
+_TRANSCRIPT_LABEL = b"TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH"
+_KDF_INFO = b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+
+class HandshakeError(Exception):
+    pass
+
+
+def _encode_bytes_value(b: bytes) -> bytes:
+    w = pw.Writer()
+    w.bytes(1, b)
+    return pw.length_delimited(w.finish())
+
+
+async def _read_length_delimited(reader: asyncio.StreamReader,
+                                 max_size: int = 1024) -> bytes:
+    # uvarint length prefix, then body
+    length = 0
+    shift = 0
+    while True:
+        b = await reader.readexactly(1)
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise HandshakeError("varint length overflow")
+    if length > max_size:
+        raise HandshakeError(f"handshake message too large: {length}")
+    return await reader.readexactly(length)
+
+
+def _encode_auth_sig(pub: PubKey, sig: bytes) -> bytes:
+    # AuthSigMessage{ crypto.PublicKey pub_key = 1 (oneof ed25519=1), bytes sig = 2 }
+    pk = pw.Writer()
+    pk.bytes(1, pub.bytes())  # PublicKey.ed25519
+    w = pw.Writer()
+    w.message(1, pk.finish())
+    w.bytes(2, sig)
+    return pw.length_delimited(w.finish())
+
+
+def _decode_auth_sig(body: bytes) -> Tuple[PubKey, bytes]:
+    fields = pw.fields_dict(body)
+    if 1 not in fields or 2 not in fields:
+        raise HandshakeError("malformed AuthSigMessage")
+    pk_fields = pw.fields_dict(fields[1][0])
+    if 1 not in pk_fields:
+        raise HandshakeError("unsupported pubkey type in AuthSigMessage")
+    return Ed25519PubKey(pk_fields[1][0]), fields[2][0]
+
+
+class _Nonce:
+    __slots__ = ("counter",)
+
+    def __init__(self):
+        self.counter = 0
+
+    def bytes(self) -> bytes:
+        return b"\x00\x00\x00\x00" + self.counter.to_bytes(8, "little")
+
+    def incr(self) -> None:
+        self.counter += 1
+        if self.counter >= 1 << 64:
+            raise RuntimeError("nonce overflow; terminate session")
+
+
+class SecretConnection:
+    """Encrypted, authenticated stream over (reader, writer)."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 send_key: bytes, recv_key: bytes, remote_pubkey: PubKey):
+        self._reader = reader
+        self._writer = writer
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+        self._recv_buffer = b""
+        self.remote_pubkey = remote_pubkey
+
+    # -- handshake -----------------------------------------------------------
+
+    @classmethod
+    async def make(cls, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                   local_priv: PrivKey) -> "SecretConnection":
+        """(secret_connection.go:92 MakeSecretConnection)"""
+        eph_priv = X25519PrivateKey.generate()
+        loc_eph_pub = eph_priv.public_key().public_bytes_raw()
+
+        writer.write(_encode_bytes_value(loc_eph_pub))
+        await writer.drain()
+        rem_msg = await _read_length_delimited(reader)
+        rem_fields = pw.fields_dict(rem_msg)
+        rem_eph_pub = rem_fields.get(1, [b""])[0]
+        if len(rem_eph_pub) != 32:
+            raise HandshakeError("bad ephemeral pubkey length")
+
+        lo, hi = sorted([loc_eph_pub, rem_eph_pub])
+        transcript = Transcript(_TRANSCRIPT_LABEL)
+        transcript.append_message(b"EPHEMERAL_LOWER_PUBLIC_KEY", lo)
+        transcript.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi)
+
+        dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
+        if dh_secret == b"\x00" * 32:
+            raise HandshakeError("low order point from remote peer")
+        transcript.append_message(b"DH_SECRET", dh_secret)
+
+        loc_is_least = loc_eph_pub == lo
+        okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None,
+                   info=_KDF_INFO).derive(dh_secret)
+        if loc_is_least:
+            recv_key, send_key = okm[0:32], okm[32:64]
+        else:
+            send_key, recv_key = okm[0:32], okm[32:64]
+
+        challenge = transcript.challenge_bytes(b"SECRET_CONNECTION_MAC", 32)
+
+        sc = cls(reader, writer, send_key, recv_key, remote_pubkey=None)
+
+        sig = local_priv.sign(challenge)
+        await sc.write_msg(_encode_auth_sig(local_priv.pub_key(), sig))
+        auth_body = await sc.read_msg(max_size=1024)
+        # strip the inner varint length prefix
+        ln, pos = pw.decode_varint(auth_body, 0)
+        rem_pub, rem_sig = _decode_auth_sig(auth_body[pos:pos + ln])
+        if not rem_pub.verify_signature(challenge, rem_sig):
+            raise HandshakeError("challenge verification failed")
+        sc.remote_pubkey = rem_pub
+        return sc
+
+    # -- framing -------------------------------------------------------------
+
+    async def write(self, data: bytes) -> None:
+        """Chunk into sealed frames (secret_connection.go:187 Write)."""
+        while data:
+            chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
+            frame = bytearray(TOTAL_FRAME_SIZE)
+            frame[0:4] = len(chunk).to_bytes(4, "little")
+            frame[4:4 + len(chunk)] = chunk
+            sealed = self._send_aead.encrypt(self._send_nonce.bytes(),
+                                             bytes(frame), None)
+            self._send_nonce.incr()
+            self._writer.write(sealed)
+        await self._writer.drain()
+
+    async def read(self) -> bytes:
+        """One chunk (<= 1024 bytes) from the next frame, or buffered rest."""
+        if self._recv_buffer:
+            out, self._recv_buffer = self._recv_buffer, b""
+            return out
+        sealed = await self._reader.readexactly(SEALED_FRAME_SIZE)
+        frame = self._recv_aead.decrypt(self._recv_nonce.bytes(), sealed, None)
+        self._recv_nonce.incr()
+        chunk_len = int.from_bytes(frame[0:4], "little")
+        if chunk_len > DATA_MAX_SIZE:
+            raise RuntimeError("chunk length exceeds dataMaxSize")
+        return frame[4:4 + chunk_len]
+
+    async def read_exactly(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = await self.read()
+            if not chunk:
+                raise asyncio.IncompleteReadError(out, n)
+            take = min(n - len(out), len(chunk))
+            out += chunk[:take]
+            self._recv_buffer = chunk[take:] + self._recv_buffer
+        return out
+
+    # -- length-delimited messages over the encrypted stream -----------------
+
+    async def write_msg(self, framed: bytes) -> None:
+        await self.write(framed)
+
+    async def read_msg(self, max_size: int = 10 * 1024 * 1024) -> bytes:
+        """Read a uvarint-length-delimited message; returns prefix+body."""
+        header = b""
+        while True:
+            b = await self.read_exactly(1)
+            header += b
+            if not b[0] & 0x80:
+                break
+            if len(header) > 5:
+                raise RuntimeError("varint overflow")
+        length, _ = pw.decode_varint(header, 0)
+        if length > max_size:
+            raise RuntimeError(f"message too large: {length}")
+        body = await self.read_exactly(length)
+        return header + body
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass

@@ -1,0 +1,54 @@
+"""Node identity key (reference p2p/key.go).
+
+A persistent ed25519 keypair; the node ID is the lowercase hex of the
+pubkey's address (SHA256-20), exactly the reference's ``PubKeyToID``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from ..crypto import Ed25519PrivKey, PrivKey
+
+
+def pubkey_to_id(pub) -> str:
+    """(p2p/key.go PubKeyToID)"""
+    return pub.address().hex()
+
+
+@dataclass
+class NodeKey:
+    priv_key: PrivKey
+
+    @property
+    def id(self) -> str:
+        return pubkey_to_id(self.priv_key.pub_key())
+
+    def pub_key(self):
+        return self.priv_key.pub_key()
+
+    def save_as(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = {"priv_key": {"type": "tendermint/PrivKeyEd25519",
+                            "value": self.priv_key.bytes().hex()}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "NodeKey":
+        with open(path) as f:
+            doc = json.load(f)
+        return cls(Ed25519PrivKey(bytes.fromhex(doc["priv_key"]["value"])))
+
+    @classmethod
+    def load_or_gen(cls, path: str, seed: bytes = None) -> "NodeKey":
+        """(p2p/key.go LoadOrGenNodeKey)"""
+        if os.path.exists(path):
+            return cls.load(path)
+        nk = cls(Ed25519PrivKey.generate(seed))
+        nk.save_as(path)
+        return nk

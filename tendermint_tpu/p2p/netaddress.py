@@ -1,0 +1,44 @@
+"""ID@host:port network addresses (reference p2p/netaddress.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class AddressError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    id: str
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.id}@{self.host}:{self.port}"
+
+    @classmethod
+    def parse(cls, addr: str) -> "NetAddress":
+        """Accepts id@host:port (id mandatory, reference NewNetAddressString)."""
+        if "@" not in addr:
+            raise AddressError(f"address {addr!r} missing node id")
+        node_id, hostport = addr.split("@", 1)
+        node_id = node_id.lower()
+        if len(node_id) != 40 or any(c not in "0123456789abcdef" for c in node_id):
+            raise AddressError(f"invalid node id {node_id!r}")
+        if ":" not in hostport:
+            raise AddressError(f"address {addr!r} missing port")
+        host, port_s = hostport.rsplit(":", 1)
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise AddressError(f"bad port in {addr!r}")
+        if not 0 < port < 65536:
+            raise AddressError(f"port out of range in {addr!r}")
+        return cls(node_id, host or "127.0.0.1", port)
+
+
+def parse_peer_list(s: str) -> list:
+    """Comma-separated id@host:port list (config p2p.persistent_peers)."""
+    return [NetAddress.parse(p.strip()) for p in s.split(",") if p.strip()]

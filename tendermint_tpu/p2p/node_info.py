@@ -1,0 +1,99 @@
+"""NodeInfo: the post-encryption handshake payload
+(reference p2p/node_info.go DefaultNodeInfo + CompatibleWith).
+
+Carries protocol versions, node ID, listen address, network (chain id),
+software version, advertised channels, and moniker. Exchanged as a
+length-delimited protobuf right after SecretConnection establishment
+(p2p/transport.go:535 handshake); peers are rejected on network or
+block-protocol mismatch, missing common channels, or ID spoofing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from ..libs import protowire as pw
+
+P2P_PROTOCOL = 8      # reference version/version.go:16 P2PProtocol
+BLOCK_PROTOCOL = 11   # reference version/version.go:22 BlockProtocol
+SOFTWARE_VERSION = "tendermint-tpu/0.1.0"
+
+
+class NodeInfoError(Exception):
+    pass
+
+
+@dataclass
+class NodeInfo:
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""
+    version: str = SOFTWARE_VERSION
+    channels: bytes = b""
+    moniker: str = "anonymous"
+    protocol_p2p: int = P2P_PROTOCOL
+    protocol_block: int = BLOCK_PROTOCOL
+    protocol_app: int = 0
+    rpc_address: str = ""
+    tx_index: str = "on"
+
+    def encode(self) -> bytes:
+        """Length-delimited DefaultNodeInfo (proto/tendermint/p2p/types.proto)."""
+        ver = pw.Writer()
+        ver.varint(1, self.protocol_p2p)
+        ver.varint(2, self.protocol_block)
+        ver.varint(3, self.protocol_app)
+        other = pw.Writer()
+        other.string(1, self.tx_index)
+        other.string(2, self.rpc_address)
+        w = pw.Writer()
+        w.message(1, ver.finish())
+        w.string(2, self.node_id)
+        w.string(3, self.listen_addr)
+        w.string(4, self.network)
+        w.string(5, self.version)
+        w.bytes(6, self.channels)
+        w.string(7, self.moniker)
+        w.message(8, other.finish())
+        return pw.length_delimited(w.finish())
+
+    @classmethod
+    def decode(cls, body: bytes) -> "NodeInfo":
+        f = pw.fields_dict(body)
+        info = cls()
+        if 1 in f:
+            vf = pw.fields_dict(f[1][0])
+            info.protocol_p2p = vf.get(1, [0])[0]
+            info.protocol_block = vf.get(2, [0])[0]
+            info.protocol_app = vf.get(3, [0])[0]
+        info.node_id = f.get(2, [b""])[0].decode()
+        info.listen_addr = f.get(3, [b""])[0].decode()
+        info.network = f.get(4, [b""])[0].decode()
+        info.version = f.get(5, [b""])[0].decode()
+        info.channels = f.get(6, [b""])[0]
+        info.moniker = f.get(7, [b""])[0].decode()
+        if 8 in f:
+            of = pw.fields_dict(f[8][0])
+            info.tx_index = of.get(1, [b""])[0].decode()
+            info.rpc_address = of.get(2, [b""])[0].decode()
+        return info
+
+    def validate_basic(self) -> None:
+        if not self.node_id:
+            raise NodeInfoError("empty node id")
+        if len(self.channels) > 64:
+            raise NodeInfoError("too many channels")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """(p2p/node_info.go CompatibleWith)"""
+        if self.protocol_block != other.protocol_block:
+            raise NodeInfoError(
+                f"block protocol mismatch: {self.protocol_block} vs "
+                f"{other.protocol_block}")
+        if self.network != other.network:
+            raise NodeInfoError(
+                f"network mismatch: {self.network!r} vs {other.network!r}")
+        if self.channels and other.channels:
+            if not set(self.channels) & set(other.channels):
+                raise NodeInfoError("no common channels")

@@ -12,12 +12,14 @@ logger = logging.getLogger("tmtpu.p2p")
 
 
 class Switch:
-    def __init__(self, node_id: str):
+    def __init__(self, node_id: str, transport=None):
         self.node_id = node_id
+        self.transport = transport  # TCPTransport or None (in-proc)
         self.reactors: Dict[str, Reactor] = {}
         self._reactors_by_ch: Dict[int, Reactor] = {}
         self.peers: Dict[str, Peer] = {}
         self._running = False
+        self._dial_tasks: Dict[str, asyncio.Task] = {}  # persistent redials
 
     # -- reactors (switch.go:163 AddReactor) -------------------------------
 
@@ -44,10 +46,87 @@ class Switch:
 
     async def stop(self) -> None:
         self._running = False
+        for t in self._dial_tasks.values():
+            t.cancel()
+        self._dial_tasks.clear()
+        # peers BEFORE transport: Server.wait_closed (py3.12) blocks until
+        # every accepted connection is closed, and those sockets are owned by
+        # the peers' SecretConnections
         for peer in list(self.peers.values()):
             await self.stop_peer_gracefully(peer)
+        if self.transport is not None:
+            await self.transport.close()
         for reactor in self.reactors.values():
             await reactor.stop()
+
+    # -- TCP transport wiring (switch.go:665 acceptRoutine, :430 reconnect) --
+
+    async def listen(self, host: str, port: int):
+        """Start the transport's accept loop; inbound peers auto-register."""
+        if self.transport is None:
+            raise RuntimeError("switch has no transport")
+        return await self.transport.listen(host, port, self._on_inbound_peer)
+
+    async def _on_inbound_peer(self, peer) -> None:
+        if not self._running or peer.id in self.peers or peer.id == self.node_id:
+            await peer.stop()
+            return
+        peer.bind(self)
+        peer.start()
+        await self.add_peer(peer)
+
+    async def dial_peer(self, addr, persistent: bool = False) -> bool:
+        """One dial attempt; -> True when the peer is registered."""
+        if self.transport is None:
+            raise RuntimeError("switch has no transport")
+        if addr.id in self.peers or addr.id == self.node_id:
+            return False
+        try:
+            peer = await self.transport.dial(addr, persistent=persistent)
+        except Exception as e:
+            logger.debug("%s: dial %s failed: %s", self.node_id[:8], addr, e)
+            return False
+        if peer.id in self.peers:  # simultaneous connect race: keep existing
+            await peer.stop()
+            return False
+        peer.bind(self)
+        peer.start()
+        await self.add_peer(peer)
+        return True
+
+    def dial_peers_async(self, addrs, persistent: bool = False) -> None:
+        """(switch.go DialPeersAsync) fire-and-forget with reconnect for
+        persistent peers (exponential backoff, switch.go:430)."""
+        for addr in addrs:
+            if addr.id in self._dial_tasks:
+                continue
+            t = asyncio.create_task(self._dial_loop(addr, persistent))
+            self._dial_tasks[addr.id] = t
+
+    async def _dial_loop(self, addr, persistent: bool) -> None:
+        backoff = 1.0
+        try:
+            while self._running if persistent else True:
+                if addr.id in self.peers:
+                    if not persistent:
+                        return
+                    await asyncio.sleep(1.0)
+                    continue
+                ok = await self.dial_peer(addr, persistent=persistent)
+                if ok:
+                    if not persistent:
+                        return
+                    backoff = 1.0
+                    await asyncio.sleep(1.0)
+                    continue
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                if not persistent and backoff > 8.0:
+                    return
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self._dial_tasks.pop(addr.id, None)
 
     # -- peers -------------------------------------------------------------
 

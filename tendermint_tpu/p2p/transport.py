@@ -1,0 +1,171 @@
+"""TCP MultiplexTransport: listen/dial, upgrading raw conns through
+SecretConnection → NodeInfo handshake → MConnection-backed Peer
+(reference p2p/transport.go:138,193,405,535; p2p/peer.go:23).
+
+The Peer surface is identical to the in-proc transport's, so every reactor
+works unchanged over real sockets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Dict, List, Optional
+
+from ..libs import protowire as pw
+from .base import ChannelDescriptor, Peer
+from .conn.mconnection import MConnConfig, MConnection
+from .conn.secret_connection import SecretConnection
+from .key import NodeKey, pubkey_to_id
+from .netaddress import NetAddress
+from .node_info import NodeInfo, NodeInfoError
+
+logger = logging.getLogger("tmtpu.p2p.tcp")
+
+HANDSHAKE_TIMEOUT = 20.0
+DIAL_TIMEOUT = 3.0
+
+
+class TransportError(Exception):
+    pass
+
+
+class TCPPeer(Peer):
+    """A peer over an MConnection on a SecretConnection (p2p/peer.go)."""
+
+    def __init__(self, node_info: NodeInfo, mconn_factory, outbound: bool,
+                 persistent: bool = False, socket_addr: Optional[NetAddress] = None):
+        super().__init__(node_info.node_id, outbound, persistent)
+        self.node_info = node_info
+        self.socket_addr = socket_addr
+        self._mconn: MConnection = mconn_factory(self._on_receive, self._on_error)
+        self._switch = None
+        self._running = False
+
+    def bind(self, switch) -> None:
+        self._switch = switch
+
+    def start(self) -> None:
+        self._running = True
+        self._mconn.start()
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self.try_send(channel_id, msg)
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        if not self._running:
+            return False
+        return self._mconn.try_send(channel_id, msg)
+
+    async def send_wait(self, channel_id: int, msg: bytes) -> bool:
+        if not self._running:
+            return False
+        return await self._mconn.send(channel_id, msg)
+
+    def is_running(self) -> bool:
+        return self._running
+
+    async def stop(self) -> None:
+        self._running = False
+        await self._mconn.stop()
+
+    async def _on_receive(self, channel_id: int, msg: bytes) -> None:
+        if self._switch is not None:
+            await self._switch.dispatch(channel_id, self, msg)
+
+    async def _on_error(self, err: Exception) -> None:
+        self._running = False
+        if self._switch is not None:
+            await self._switch.stop_peer_for_error(self, f"conn error: {err}")
+
+
+class TCPTransport:
+    """(p2p/transport.go MultiplexTransport)"""
+
+    def __init__(self, node_key: NodeKey, node_info: NodeInfo,
+                 chan_descs: List[ChannelDescriptor],
+                 mconn_config: Optional[MConnConfig] = None):
+        self.node_key = node_key
+        self.node_info = node_info
+        self.chan_descs = chan_descs
+        self.mconn_config = mconn_config or MConnConfig()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.listen_addr: Optional[NetAddress] = None
+        self._on_inbound: Optional[Callable] = None
+
+    # -- listening -----------------------------------------------------------
+
+    async def listen(self, host: str, port: int, on_inbound) -> NetAddress:
+        """Start accepting; on_inbound(TCPPeer) is called per upgraded conn."""
+        self._on_inbound = on_inbound
+        self._server = await asyncio.start_server(self._accept, host, port)
+        actual_port = self._server.sockets[0].getsockname()[1]
+        self.listen_addr = NetAddress(self.node_key.id, host, actual_port)
+        self.node_info.listen_addr = f"tcp://{host}:{actual_port}"
+        logger.info("p2p listening on %s", self.listen_addr)
+        return self.listen_addr
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            peer = await asyncio.wait_for(
+                self._upgrade(reader, writer, outbound=False,
+                              expected_id=None),
+                HANDSHAKE_TIMEOUT)
+        except Exception as e:
+            logger.debug("inbound upgrade failed: %s", e)
+            writer.close()
+            return
+        if self._on_inbound is not None:
+            await self._on_inbound(peer)
+
+    # -- dialing -------------------------------------------------------------
+
+    async def dial(self, addr: NetAddress, persistent: bool = False) -> TCPPeer:
+        """(transport.go Dial) TCP connect + upgrade + ID verification."""
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(addr.host, addr.port), DIAL_TIMEOUT)
+        try:
+            peer = await asyncio.wait_for(
+                self._upgrade(reader, writer, outbound=True,
+                              expected_id=addr.id),
+                HANDSHAKE_TIMEOUT)
+        except Exception:
+            writer.close()
+            raise
+        peer.persistent = persistent
+        peer.socket_addr = addr
+        return peer
+
+    # -- the upgrade path (transport.go:405 upgrade, :535 handshake) ---------
+
+    async def _upgrade(self, reader, writer, outbound: bool,
+                       expected_id: Optional[str]) -> TCPPeer:
+        sc = await SecretConnection.make(reader, writer, self.node_key.priv_key)
+        conn_id = pubkey_to_id(sc.remote_pubkey)
+        if expected_id is not None and conn_id != expected_id:
+            raise TransportError(
+                f"dialed {expected_id[:12]} but connected to {conn_id[:12]}")
+
+        # NodeInfo exchange over the encrypted conn (both directions async
+        # like the reference's cmn.Parallel)
+        await sc.write_msg(self.node_info.encode())
+        raw = await asyncio.wait_for(sc.read_msg(max_size=10240), HANDSHAKE_TIMEOUT)
+        ln, pos = pw.decode_varint(raw, 0)
+        rem_info = NodeInfo.decode(raw[pos:pos + ln])
+        rem_info.validate_basic()
+        if rem_info.node_id != conn_id:
+            raise TransportError("node info ID does not match secret-conn pubkey")
+        self.node_info.compatible_with(rem_info)
+
+        def mconn_factory(on_receive, on_error):
+            return MConnection(sc, self.chan_descs, on_receive, on_error,
+                               self.mconn_config)
+
+        return TCPPeer(rem_info, mconn_factory, outbound)

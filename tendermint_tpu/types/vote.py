@@ -42,6 +42,15 @@ class Vote:
         if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
             raise ErrVoteInvalidSignature()
 
+    def verify_with(self, chain_id: str, pub_key: crypto.PubKey,
+                    verifier) -> None:
+        """Same decisions as :meth:`verify`, signature check routed through a
+        verifier (micro-batch cache / device path; vote_set.go:205 hot call)."""
+        if pub_key.address() != self.validator_address:
+            raise ErrVoteInvalidValidatorAddress()
+        if not verifier.verify(pub_key, self.sign_bytes(chain_id), self.signature):
+            raise ErrVoteInvalidSignature()
+
     def copy(self) -> "Vote":
         return Vote(self.type, self.height, self.round, self.block_id,
                     self.timestamp_ns, self.validator_address,

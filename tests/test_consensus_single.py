@@ -157,3 +157,41 @@ def test_restart_recovers_via_handshake(tmp_path):
         assert state2.last_block_height == committed
 
     asyncio.run(run())
+
+
+def test_timeout_ticker_ignores_earlier_hrs():
+    """ticker.go:94: a schedule for an earlier-or-equal (H,R,S) must NOT
+    cancel/replace a pending later-step timeout (liveness regression guard)."""
+    asyncio.run(_run_ticker_guard())
+
+
+async def _run_ticker_guard():
+    from tendermint_tpu.consensus.round_state import RoundStep
+
+    cs, mempool, app, event_bus, pv, _ = build_node()
+    try:
+        # pending: (h=5, r=1, PRECOMMIT_WAIT), long duration so it stays pending
+        cs._schedule_timeout(30.0, 5, 1, RoundStep.PRECOMMIT_WAIT)
+        pending = cs._pending_timeout
+        assert (pending.height, pending.round, pending.step) == (5, 1, int(RoundStep.PRECOMMIT_WAIT))
+        task = cs._timeout_task
+
+        # earlier height / earlier round / earlier-or-equal step: all ignored
+        cs._schedule_timeout(0.001, 4, 9, RoundStep.COMMIT)
+        cs._schedule_timeout(0.001, 5, 0, RoundStep.COMMIT)
+        cs._schedule_timeout(0.001, 5, 1, RoundStep.PROPOSE)
+        cs._schedule_timeout(0.001, 5, 1, RoundStep.PRECOMMIT_WAIT)
+        assert cs._pending_timeout is pending
+        assert cs._timeout_task is task and not task.cancelled()
+
+        # later step / later round / later height: replace
+        cs._schedule_timeout(30.0, 5, 1, RoundStep.COMMIT)
+        assert cs._pending_timeout.step == int(RoundStep.COMMIT)
+        cs._schedule_timeout(30.0, 5, 2, RoundStep.NEW_ROUND)
+        assert cs._pending_timeout.round == 2
+        cs._schedule_timeout(30.0, 6, 0, RoundStep.NEW_HEIGHT)
+        assert cs._pending_timeout.height == 6
+        assert task.cancelled() or task.done() or cs._timeout_task is not task
+    finally:
+        cs._timeout_task and cs._timeout_task.cancel()
+        await asyncio.sleep(0)

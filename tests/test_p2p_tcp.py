@@ -1,0 +1,225 @@
+"""TCP transport stack: SecretConnection (STS handshake, AEAD frames),
+Merlin transcript, NodeInfo handshake, MConnection mux, Switch dial/accept —
+and the 4-validator consensus net running over real sockets
+(reference p2p/conn/secret_connection.go, p2p/transport.go, p2p/switch.go).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.libs.merlin import Transcript
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    Reactor,
+    Switch,
+    TCPTransport,
+)
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+
+
+def test_merlin_transcript_matches_upstream_vector():
+    """The canonical merlin test vector (merlin-rust transcript.rs): proves
+    byte-compatibility with gtank/merlin used by the reference."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    c = t.challenge_bytes(b"challenge", 32)
+    assert c.hex() == \
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+
+
+def _spawn_pair():
+    """Two SecretConnections over a real localhost socket."""
+    async def run():
+        k1 = crypto.Ed25519PrivKey.generate(b"\x01" * 32)
+        k2 = crypto.Ed25519PrivKey.generate(b"\x02" * 32)
+        server_side = {}
+        served = asyncio.Event()
+
+        async def on_conn(reader, writer):
+            server_side["sc"] = await SecretConnection.make(reader, writer, k2)
+            served.set()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        sc1 = await SecretConnection.make(reader, writer, k1)
+        await asyncio.wait_for(served.wait(), 5)
+        sc2 = server_side["sc"]
+        return k1, k2, sc1, sc2, server
+    return run
+
+
+def test_secret_connection_sts_and_frames():
+    async def run():
+        k1, k2, sc1, sc2, server = await _spawn_pair()()
+        # mutual authentication
+        assert sc1.remote_pubkey.bytes() == k2.pub_key().bytes()
+        assert sc2.remote_pubkey.bytes() == k1.pub_key().bytes()
+        # small message
+        await sc1.write(b"hello")
+        assert await sc2.read() == b"hello"
+        # multi-frame message (> 1024)
+        big = bytes(range(256)) * 17  # 4352 bytes
+        await sc2.write(big)
+        got = await sc1.read_exactly(len(big))
+        assert got == big
+        server.close()
+    asyncio.run(run())
+
+
+class EchoReactor(Reactor):
+    CH = 0x77
+
+    def __init__(self):
+        super().__init__("ECHO")
+        self.got = asyncio.Queue()
+
+    def get_channels(self):
+        return [ChannelDescriptor(self.CH, priority=5, send_queue_capacity=10)]
+
+    async def receive(self, channel_id, peer, msg_bytes):
+        await self.got.put((peer.id, msg_bytes))
+
+
+def _mk_tcp_switch(seed: bytes, network: str = "test-net"):
+    nk = NodeKey(crypto.Ed25519PrivKey.generate(seed))
+    er = EchoReactor()
+    info = NodeInfo(node_id=nk.id, network=network,
+                    channels=bytes([EchoReactor.CH]))
+    transport = TCPTransport(nk, info, er.get_channels())
+    sw = Switch(nk.id, transport=transport)
+    sw.add_reactor("ECHO", er)
+    return sw, er, nk
+
+
+def test_tcp_switch_dial_accept_and_mux():
+    async def run():
+        sw1, er1, nk1 = _mk_tcp_switch(b"\x11" * 32)
+        sw2, er2, nk2 = _mk_tcp_switch(b"\x12" * 32)
+        await sw1.start()
+        await sw2.start()
+        addr1 = await sw1.listen("127.0.0.1", 0)
+        assert await sw2.dial_peer(addr1)
+        # wait for sw1 to register the inbound peer
+        for _ in range(100):
+            if sw1.peers:
+                break
+            await asyncio.sleep(0.01)
+        assert nk2.id in sw1.peers and nk1.id in sw2.peers
+
+        # message both ways through the MConnection mux
+        assert sw2.peers[nk1.id].try_send(EchoReactor.CH, b"ping-from-2")
+        pid, msg = await asyncio.wait_for(er1.got.get(), 5)
+        assert (pid, msg) == (nk2.id, b"ping-from-2")
+        big = b"\xab" * 5000  # multi-packet message
+        assert sw1.peers[nk2.id].try_send(EchoReactor.CH, big)
+        pid, msg = await asyncio.wait_for(er2.got.get(), 5)
+        assert (pid, msg) == (nk1.id, big)
+
+        await sw2.stop()
+        await sw1.stop()
+    asyncio.run(run())
+
+
+def test_tcp_rejects_network_mismatch():
+    async def run():
+        sw1, _, _ = _mk_tcp_switch(b"\x21" * 32, network="chain-A")
+        sw2, _, nk2 = _mk_tcp_switch(b"\x22" * 32, network="chain-B")
+        await sw1.start()
+        await sw2.start()
+        addr1 = await sw1.listen("127.0.0.1", 0)
+        assert not await sw2.dial_peer(addr1)
+        assert not sw2.peers
+        await sw2.stop()
+        await sw1.stop()
+    asyncio.run(run())
+
+
+def test_tcp_rejects_id_spoof():
+    async def run():
+        sw1, _, nk1 = _mk_tcp_switch(b"\x31" * 32)
+        sw2, _, _ = _mk_tcp_switch(b"\x32" * 32)
+        await sw1.start()
+        await sw2.start()
+        addr1 = await sw1.listen("127.0.0.1", 0)
+        wrong = NetAddress("ab" * 20, addr1.host, addr1.port)
+        assert not await sw2.dial_peer(wrong)
+        await sw2.stop()
+        await sw1.stop()
+    asyncio.run(run())
+
+
+def test_persistent_peer_reconnects():
+    async def run():
+        sw1, _, nk1 = _mk_tcp_switch(b"\x41" * 32)
+        sw2, _, nk2 = _mk_tcp_switch(b"\x42" * 32)
+        await sw1.start()
+        await sw2.start()
+        addr1 = await sw1.listen("127.0.0.1", 0)
+        sw2.dial_peers_async([addr1], persistent=True)
+        for _ in range(200):
+            if nk1.id in sw2.peers:
+                break
+            await asyncio.sleep(0.01)
+        assert nk1.id in sw2.peers
+
+        # kill the connection from sw1's side; sw2 must redial
+        await sw1.stop_peer_for_error(sw1.peers[nk2.id], "test kill")
+        for _ in range(600):
+            if nk2.id in sw1.peers and nk1.id in sw2.peers:
+                break
+            await asyncio.sleep(0.01)
+        assert nk1.id in sw2.peers, "persistent peer did not reconnect"
+        await sw2.stop()
+        await sw1.stop()
+    asyncio.run(run())
+
+
+def test_four_validator_consensus_over_tcp():
+    """VERDICT task 4 done-criterion: the consensus net runs over real TCP
+    sockets (SecretConnection + MConnection), not just in-proc."""
+    from tests.test_consensus_net import Node, make_net, wait_all_height
+
+    async def run():
+        nodes = make_net(4)
+        switches = []
+        for i, nd in enumerate(nodes):
+            nk = NodeKey(crypto.Ed25519PrivKey.generate(bytes([0x90 + i]) * 32))
+            descs = []
+            for r in nd.switch.reactors.values():
+                descs.extend(r.get_channels())
+            info = NodeInfo(node_id=nk.id, network="net-chain",
+                            channels=bytes(d.id for d in descs))
+            transport = TCPTransport(nk, info, descs)
+            sw = Switch(nk.id, transport=transport)
+            # re-register the same reactor objects on the TCP switch
+            for name, r in nd.switch.reactors.items():
+                r.switch = None
+                sw.add_reactor(name, r)
+            nd.switch = sw
+            switches.append(sw)
+        addrs = []
+        for nd in nodes:
+            await nd.switch.start()
+            addrs.append(await nd.switch.listen("127.0.0.1", 0))
+        for nd in nodes:
+            await nd.cs.start()
+        # full mesh dial
+        for i, nd in enumerate(nodes):
+            nd.switch.dial_peers_async(addrs[:i], persistent=True)
+        try:
+            await wait_all_height(nodes, 3, timeout=60.0)
+        finally:
+            for nd in nodes:
+                await nd.cs.stop()
+                await nd.switch.stop()
+        heights = [nd.cs.state.last_block_height for nd in nodes]
+        assert min(heights) >= 3, heights
+        hashes = {nd.block_store.load_block_meta(2).header.hash() for nd in nodes}
+        assert len(hashes) == 1
+    asyncio.run(run())
