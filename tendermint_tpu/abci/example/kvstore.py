@@ -100,6 +100,80 @@ class KVStoreApplication(Application):
         return abci.ResponseCommit(data=self.app_hash)
 
 
+class SnapshotKVStoreApplication(KVStoreApplication):
+    """kvstore + state-sync snapshots (the reference's e2e app shape,
+    test/e2e/app/snapshots.go): every ``interval`` heights the full app state
+    is serialized to JSON and split into fixed-size chunks."""
+
+    CHUNK_SIZE = 1024
+
+    def __init__(self, interval: int = 4):
+        super().__init__()
+        self.snapshot_interval = interval
+        self._snapshots: Dict[int, List[bytes]] = {}  # height -> chunks
+        self._restore: Optional[Dict] = None
+
+    def commit(self) -> abci.ResponseCommit:
+        resp = super().commit()
+        if self.snapshot_interval and self.height % self.snapshot_interval == 0:
+            blob = json.dumps({
+                "state": self.state, "tx_count": self.tx_count,
+                "height": self.height, "validators": self.validators,
+            }, sort_keys=True).encode()
+            chunks = [blob[i:i + self.CHUNK_SIZE]
+                      for i in range(0, max(len(blob), 1), self.CHUNK_SIZE)]
+            self._snapshots[self.height] = chunks
+        return resp
+
+    def list_snapshots(self, req: abci.RequestListSnapshots
+                       ) -> abci.ResponseListSnapshots:
+        out = []
+        for h, chunks in sorted(self._snapshots.items()):
+            out.append(abci.Snapshot(
+                height=h, format=1, chunks=len(chunks),
+                hash=hashlib.sha256(b"".join(chunks)).digest()))
+        return abci.ResponseListSnapshots(snapshots=out)
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk
+                            ) -> abci.ResponseLoadSnapshotChunk:
+        chunks = self._snapshots.get(req.height)
+        if req.format != 1 or chunks is None or not 0 <= req.chunk < len(chunks):
+            return abci.ResponseLoadSnapshotChunk(chunk=b"")
+        return abci.ResponseLoadSnapshotChunk(chunk=chunks[req.chunk])
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot
+                       ) -> abci.ResponseOfferSnapshot:
+        if req.snapshot is None or req.snapshot.format != 1:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OFFER_SNAPSHOT_REJECT_FORMAT)
+        self._restore = {"snapshot": req.snapshot, "app_hash": req.app_hash,
+                         "chunks": []}
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk
+                             ) -> abci.ResponseApplySnapshotChunk:
+        if self._restore is None:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT)
+        self._restore["chunks"].append(req.chunk)
+        snap = self._restore["snapshot"]
+        if len(self._restore["chunks"]) == snap.chunks:
+            blob = b"".join(self._restore["chunks"])
+            if hashlib.sha256(blob).digest() != snap.hash:
+                self._restore = None
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT)
+            doc = json.loads(blob)
+            self.state = dict(doc["state"])
+            self.tx_count = doc["tx_count"]
+            self.height = doc["height"]
+            self.validators = dict(doc["validators"])
+            self.app_hash = self.tx_count.to_bytes(8, "big")
+            self._restore = None
+        return abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_SNAPSHOT_CHUNK_ACCEPT)
+
+
 def tx_is_validator_update(tx: bytes) -> bool:
     return tx.decode("utf-8", errors="replace").startswith(VALIDATOR_TX_PREFIX)
 

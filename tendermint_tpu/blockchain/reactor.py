@@ -58,7 +58,7 @@ SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 class BlockchainReactor(Reactor):
     def __init__(self, state: State, block_exec: BlockExecutor,
                  block_store: BlockStore, fast_sync: bool,
-                 consensus_reactor=None):
+                 consensus_reactor=None, on_fatal=None):
         super().__init__("BLOCKCHAIN")
         self.initial_state = state
         self.state = state
@@ -68,6 +68,9 @@ class BlockchainReactor(Reactor):
         self.consensus_reactor = consensus_reactor
         self.pool = BlockPool(max(self.store.height(), state.last_block_height) + 1)
         self._pool_task: Optional[asyncio.Task] = None
+        # called with the exception on a fatal (deterministic) sync fault;
+        # the node wires this to shut itself down (the reference panics)
+        self.on_fatal = on_fatal
         self.synced = asyncio.Event()  # set on switch-to-consensus
         self.blocks_synced = 0
 
@@ -77,10 +80,35 @@ class BlockchainReactor(Reactor):
                                   recv_message_capacity=10 * 1024 * 1024)]
 
     async def start(self) -> None:
+        # idempotent: Switch.start() starts every registered reactor, and the
+        # node/state-sync paths may call start again — two concurrent pool
+        # routines would double-apply blocks
         if self.fast_sync:
-            self._pool_task = asyncio.create_task(self._pool_routine())
+            if self._pool_task is None:
+                self._pool_task = asyncio.create_task(self._pool_routine())
+                self._pool_task.add_done_callback(self._pool_done)
         else:
             self.synced.set()
+
+    async def switch_to_fast_sync(self, state: State) -> None:
+        """(reactor.go SwitchToFastSync) enter fast sync from a state-synced
+        state: re-seed the pool at the bootstrapped height and start."""
+        self.state = state
+        self.fast_sync = True
+        self.synced.clear()
+        self.pool = BlockPool(state.last_block_height + 1)
+        if self._pool_task is None:
+            self._pool_task = asyncio.create_task(self._pool_routine())
+            self._pool_task.add_done_callback(self._pool_done)
+
+    def _pool_done(self, task: asyncio.Task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            logger.critical("block sync died: %s", exc)
+            if self.on_fatal is not None:
+                self.on_fatal(exc)
 
     async def stop(self) -> None:
         if self._pool_task is not None:

@@ -1,0 +1,265 @@
+"""Operator CLI (reference cmd/tendermint/main.go:16-49 command set).
+
+Usage:  python -m tendermint_tpu.cmd [--home DIR] <command> [...]
+
+Commands: init, start, testnet, gen-node-key, show-node-id, gen-validator,
+show-validator, reset-unsafe, version. (replay/rollback/light arrive with
+their subsystems.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import shutil
+import sys
+import time
+
+from . import config as cfgmod
+from .config import Config
+
+VERSION = "tendermint-tpu/0.1.0"
+
+
+def cmd_init(args) -> int:
+    """(cmd/tendermint/commands/init.go) scaffold config + genesis + keys."""
+    from .p2p import NodeKey
+    from .privval.file_pv import FilePV
+    from .types import GenesisDoc, GenesisValidator
+
+    cfg = Config(root_dir=args.home)
+    if args.chain_id:
+        cfg.base.chain_id = args.chain_id
+    os.makedirs(os.path.join(args.home, cfgmod.CONFIG_DIR), exist_ok=True)
+    os.makedirs(os.path.join(args.home, cfgmod.DATA_DIR), exist_ok=True)
+
+    pv_key, pv_state = cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+    if os.path.exists(pv_key):
+        pv = FilePV.load(pv_key, pv_state)
+        print(f"found existing validator key {pv_key}")
+    else:
+        pv = FilePV.generate(pv_key, pv_state)
+        pv.save()
+        print(f"generated validator key {pv_key}")
+
+    nk = NodeKey.load_or_gen(cfg.node_key_file())
+    print(f"node id: {nk.id}")
+
+    gen_file = cfg.genesis_file()
+    if not os.path.exists(gen_file):
+        chain_id = args.chain_id or f"test-chain-{os.urandom(3).hex()}"
+        genesis = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        genesis.save_as(gen_file)
+        print(f"generated genesis {gen_file} (chain {chain_id})")
+    cfg.save()
+    print(f"wrote config {os.path.join(args.home, 'config', 'config.toml')}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """(cmd/tendermint/commands/run_node.go) run a node until SIGINT."""
+    from .node import Node
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname).1s %(message)s")
+    cfg = Config.load(args.home)
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    cfg.validate_basic()
+    node = Node.default(cfg)
+
+    async def run():
+        await node.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        try:
+            import signal
+
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+        fatal = asyncio.create_task(node.fatal_event.wait())
+        stopped = asyncio.create_task(stop.wait())
+        await asyncio.wait({fatal, stopped},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if node.fatal_event.is_set():
+            print(f"FATAL: {node.fatal_error}")
+            await node.stop()
+            raise SystemExit(1)
+        print("shutting down...")
+        fatal.cancel()
+        await node.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """(cmd/tendermint/commands/testnet.go) N-node config bundles with a
+    shared genesis and fully-meshed persistent peers."""
+    from .p2p import NodeKey
+    from .privval.file_pv import FilePV
+    from .types import GenesisDoc, GenesisValidator
+
+    n = args.v
+    out = args.output_dir
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+    pvs, node_keys, configs = [], [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = Config(root_dir=home)
+        cfg.base.chain_id = chain_id
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        os.makedirs(os.path.join(home, cfgmod.CONFIG_DIR), exist_ok=True)
+        os.makedirs(os.path.join(home, cfgmod.DATA_DIR), exist_ok=True)
+        pv = FilePV.generate(cfg.priv_validator_key_file(),
+                             cfg.priv_validator_state_file())
+        pv.save()
+        nk = NodeKey.load_or_gen(cfg.node_key_file())
+        pvs.append(pv)
+        node_keys.append(nk)
+        configs.append(cfg)
+
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), 10) for pv in pvs],
+    )
+    for i, cfg in enumerate(configs):
+        peers = ",".join(
+            f"{node_keys[j].id}@127.0.0.1:{args.starting_port + 2 * j}"
+            for j in range(n) if j != i)
+        cfg.p2p.persistent_peers = peers
+        cfg.base.fast_sync = False
+        genesis.save_as(cfg.genesis_file())
+        cfg.save()
+    print(f"wrote {n}-node testnet under {out} (chain {chain_id})")
+    for i, nk in enumerate(node_keys):
+        print(f"  node{i}: id={nk.id} p2p={configs[i].p2p.laddr} "
+              f"rpc={configs[i].rpc.laddr}")
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from .p2p import NodeKey
+
+    cfg = Config(root_dir=args.home)
+    nk = NodeKey.load_or_gen(cfg.node_key_file())
+    print(nk.id)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from .p2p import NodeKey
+
+    cfg = Config(root_dir=args.home)
+    nk = NodeKey.load(cfg.node_key_file())
+    print(nk.id)
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from .privval.file_pv import FilePV
+
+    pv = FilePV.generate("", "")
+    pub = pv.get_pub_key()
+    print(json.dumps({
+        "address": pub.address().hex().upper(),
+        "pub_key": {"type": "tendermint/PubKeyEd25519",
+                    "value": pub.bytes().hex()},
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": pv.priv_key.bytes().hex()},
+    }, indent=2))
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from .privval.file_pv import FilePV
+
+    cfg = Config(root_dir=args.home)
+    pv = FilePV.load(cfg.priv_validator_key_file(),
+                     cfg.priv_validator_state_file())
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": "tendermint/PubKeyEd25519",
+                      "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_reset_unsafe(args) -> int:
+    """(cmd unsafe-reset-all) wipe data, keep config + validator key."""
+    cfg = Config(root_dir=args.home)
+    data = os.path.join(args.home, cfgmod.DATA_DIR)
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    # reset priv validator state (sign state) but keep the key
+    state_file = cfg.priv_validator_state_file()
+    with open(state_file, "w") as f:
+        json.dump({"height": 0, "round": 0, "step": 0}, f)
+    print(f"reset {data}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tmtpu",
+                                description="tendermint-tpu node CLI")
+    p.add_argument("--home", default=os.path.expanduser("~/.tmtpu"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="scaffold config/genesis/keys")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run a node")
+    sp.add_argument("--p2p-laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--persistent-peers", dest="persistent_peers", default="")
+    sp.add_argument("--proxy-app", dest="proxy_app", default="")
+    sp.add_argument("--log-level", dest="log_level", default="info")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="generate N-node localnet configs")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--output-dir", dest="output_dir", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", dest="starting_port", type=int,
+                    default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    for name, fn in [("gen-node-key", cmd_gen_node_key),
+                     ("show-node-id", cmd_show_node_id),
+                     ("gen-validator", cmd_gen_validator),
+                     ("show-validator", cmd_show_validator),
+                     ("unsafe-reset-all", cmd_reset_unsafe),
+                     ("version", cmd_version)]:
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,10 @@ class WAL:
         self._head_size_limit = head_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
+        # fresh WAL: write #ENDHEIGHT 0 so height-1 catchup replay has its
+        # start marker (reference consensus/wal.go BaseWAL.OnStart)
+        if self._f.tell() == 0 and not os.path.exists(f"{path}.0"):
+            self.write_sync("end_height", {"height": 0})
 
     # -- writing -----------------------------------------------------------
 

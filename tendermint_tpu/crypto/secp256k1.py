@@ -1,0 +1,123 @@
+"""secp256k1 key type (reference crypto/secp256k1/secp256k1.go — pure-Go
+btcec there; OpenSSL-backed here).
+
+Semantics mirror the reference:
+* 33-byte compressed pubkeys;
+* Bitcoin-style address: RIPEMD160(SHA256(compressed pubkey))
+  (secp256k1.go:12 Address);
+* signatures are 64-byte R||S with low-S normalization
+  (secp256k1.go Sign via btcec: "Serialize" compact form without recovery
+  id); verification rejects malleable high-S signatures the same way.
+
+Host-only: consensus keys stay ed25519 (the batched device path); secp256k1
+is the optional account/validator key type the reference also supports.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from . import PrivKey, PubKey
+
+# secp256k1 group order
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+KEY_TYPE = "secp256k1"
+PUBKEY_SIZE = 33
+SIG_SIZE = 64
+
+
+def _ripemd160(b: bytes) -> bytes:
+    h = hashlib.new("ripemd160")
+    h.update(b)
+    return h.digest()
+
+
+class Secp256k1PubKey(PubKey):
+    type_name = "tendermint/PubKeySecp256k1"
+
+    def __init__(self, key: bytes):
+        if len(key) != PUBKEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUBKEY_SIZE} bytes")
+        self.key = key
+        self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), key)
+
+    def address(self) -> bytes:
+        """RIPEMD160(SHA256(pubkey)) — Bitcoin style (secp256k1.go:12)."""
+        return _ripemd160(hashlib.sha256(self.key).digest())
+
+    def bytes(self) -> bytes:
+        return self.key
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < _N and 0 < s < _N):
+            return False
+        if s > _N // 2:  # reject malleable high-S (btcec Verify convention)
+            return False
+        try:
+            self._pk.verify(encode_dss_signature(r, s), msg,
+                            ec.ECDSA(hashes.SHA256()))
+            return True
+        except InvalidSignature:
+            return False
+
+    def __eq__(self, other):
+        return isinstance(other, Secp256k1PubKey) and other.key == self.key
+
+    def __hash__(self):
+        return hash(self.key)
+
+
+class Secp256k1PrivKey(PrivKey):
+    type_name = "tendermint/PrivKeySecp256k1"
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+        self.key = key
+        self._sk = ec.derive_private_key(int.from_bytes(key, "big"),
+                                         ec.SECP256K1())
+
+    @staticmethod
+    def generate(seed: Optional[bytes] = None) -> "Secp256k1PrivKey":
+        if seed is not None:
+            # deterministic from seed: hash to scalar (test convenience; the
+            # reference's GenPrivKeySecp256k1 hashes the secret similarly)
+            d = int.from_bytes(hashlib.sha256(seed).digest(), "big") % (_N - 1) + 1
+            return Secp256k1PrivKey(d.to_bytes(32, "big"))
+        sk = ec.generate_private_key(ec.SECP256K1())
+        d = sk.private_numbers().private_value
+        return Secp256k1PrivKey(d.to_bytes(32, "big"))
+
+    def bytes(self) -> bytes:
+        return self.key
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:  # low-S normalization (btcec Sign)
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        return Secp256k1PubKey(self._sk.public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint))

@@ -7,10 +7,9 @@ PRF (merlin-rust's strobe.rs mini-STROBE), plus the transcript framing
 (``dom-sep`` / LE32 length prefixes).
 
 Pure Python; handshake-time only (a few permutations per connection), so
-speed is irrelevant. Determinism and self-consistency are unit-tested;
-cross-implementation vectors could not be fetched in this offline build —
-if byte-compatibility with gtank/merlin is ever required, validate against
-the merlin test suite first.
+speed is irrelevant. Byte-compatibility with gtank/merlin (and merlin-rust)
+is pinned by tests/test_p2p_tcp.py::test_merlin_transcript_matches_upstream_
+vector against the canonical merlin transcript test vector.
 """
 
 from __future__ import annotations

@@ -1,0 +1,12 @@
+"""Light client (reference light/): pure verifier, bisection client,
+divergence detector, providers, trusted store. All commit verification rides
+the batched device verifier through ValidatorSet.verify_commit_light*."""
+
+from .verifier import (  # noqa: F401
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+    header_expired,
+)
+from .client import LightClient, TrustOptions  # noqa: F401

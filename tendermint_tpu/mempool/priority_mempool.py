@@ -1,0 +1,195 @@
+"""v1 priority mempool (reference mempool/v1/mempool.go:36 TxMempool).
+
+Differences from v0 (clist FIFO):
+* CheckTx responses carry an app-assigned ``priority`` (and ``sender``);
+* when full, the lowest-priority resident tx is evicted IF the incoming
+  priority is strictly higher (mempool.go canAddTx/evictTx);
+* reaping returns txs in (priority desc, arrival asc) order;
+* optional TTLs: txs expire after ``ttl_num_blocks`` blocks or
+  ``ttl_duration`` seconds (mempool.go purgeExpiredTxs).
+
+Shares the v0 cache + update/recheck semantics; the v0 gossip reactor works
+unchanged against either implementation (both expose the same surface).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..abci import types as abci
+from ..abci.client import Client
+from .clist_mempool import TxCache
+
+logger = logging.getLogger("tmtpu.mempool.v1")
+
+
+@dataclass(order=True)
+class _WrappedTx:
+    sort_key: tuple = field(init=False, repr=False)
+    priority: int
+    seq: int
+    tx: bytes = field(compare=False)
+    sender: str = field(compare=False, default="")
+    gas_wanted: int = field(compare=False, default=0)
+    height: int = field(compare=False, default=0)
+    time_s: float = field(compare=False, default=0.0)
+
+    def __post_init__(self):
+        # heap pops lowest priority first (eviction order); ties: oldest last
+        self.sort_key = (self.priority, -self.seq)
+
+
+class PriorityMempool:
+    def __init__(self, proxy_app: Client, height: int = 0,
+                 max_txs: int = 5000, max_txs_bytes: int = 1 << 30,
+                 max_tx_bytes: int = 1 << 20, cache_size: int = 10000,
+                 keep_invalid_txs_in_cache: bool = False,
+                 recheck: bool = True,
+                 ttl_num_blocks: int = 0, ttl_duration: float = 0.0):
+        self._proxy_app = proxy_app
+        self.height = height
+        self.max_txs = max_txs
+        self.max_txs_bytes = max_txs_bytes
+        self.max_tx_bytes = max_tx_bytes
+        self.recheck = recheck
+        self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        self.ttl_num_blocks = ttl_num_blocks
+        self.ttl_duration = ttl_duration
+        self.cache = TxCache(cache_size)
+        self._txs: Dict[bytes, _WrappedTx] = {}   # hash -> wrapped
+        self._bytes = 0
+        self._seq = itertools.count()
+        self.tx_available_callbacks: List[Callable[[], None]] = []
+        # per-peer sent tracking lives in the reactor (shared with v0)
+        self.tx_senders: Dict[bytes, set] = {}
+
+    # -- the Mempool surface (mempool/mempool.go:30) -------------------------
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        if len(tx) > self.max_tx_bytes:
+            return abci.ResponseCheckTx(code=1, log="tx too large")
+        key = hashlib.sha256(tx).digest()
+        if not self.cache.push(tx):
+            if sender and key in self._txs:
+                self.tx_senders.setdefault(key, set()).add(sender)
+            return abci.ResponseCheckTx(code=0, log="tx already in cache")
+        res = self._proxy_app.check_tx(abci.RequestCheckTx(tx=tx))
+        if res.code != 0:
+            if not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            return res
+        wtx = _WrappedTx(priority=getattr(res, "priority", 0),
+                         seq=next(self._seq), tx=tx, sender=sender,
+                         gas_wanted=res.gas_wanted, height=self.height,
+                         time_s=time.monotonic())
+        if not self._can_add(wtx):
+            self.cache.remove(tx)
+            return abci.ResponseCheckTx(code=1, log="mempool is full")
+        self._txs[key] = wtx
+        self._bytes += len(tx)
+        if sender:
+            self.tx_senders.setdefault(key, set()).add(sender)
+        for cb in self.tx_available_callbacks:
+            cb()
+        return res
+
+    def _can_add(self, wtx: _WrappedTx) -> bool:
+        """(v1/mempool.go canAddTx + eviction) evict strictly-lower-priority
+        residents to make room; reject if still over capacity."""
+        while (len(self._txs) >= self.max_txs
+               or self._bytes + len(wtx.tx) > self.max_txs_bytes):
+            victim = min(self._txs.values(), default=None)
+            if victim is None or victim.priority >= wtx.priority:
+                return False
+            self._remove(hashlib.sha256(victim.tx).digest())
+            logger.debug("evicted tx prio=%d for prio=%d", victim.priority,
+                         wtx.priority)
+        return True
+
+    def _remove(self, key: bytes) -> None:
+        wtx = self._txs.pop(key, None)
+        if wtx is not None:
+            self._bytes -= len(wtx.tx)
+        self.tx_senders.pop(key, None)
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """(v1/mempool.go ReapMaxBytesMaxGas) priority desc, arrival asc."""
+        ordered = sorted(self._txs.values(),
+                         key=lambda w: (-w.priority, w.seq))
+        out, total_b, total_g = [], 0, 0
+        for w in ordered:
+            if max_bytes >= 0 and total_b + len(w.tx) > max_bytes:
+                continue
+            if max_gas >= 0 and total_g + w.gas_wanted > max_gas:
+                continue
+            out.append(w.tx)
+            total_b += len(w.tx)
+            total_g += w.gas_wanted
+        return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        ordered = sorted(self._txs.values(),
+                         key=lambda w: (-w.priority, w.seq))
+        return [w.tx for w in ordered[:max(0, n)]]
+
+    def update(self, height: int, txs: List[bytes],
+               deliver_results: Optional[List] = None) -> None:
+        """(v1/mempool.go Update) drop committed txs, purge expired,
+        recheck the rest."""
+        self.height = height
+        for i, tx in enumerate(txs):
+            key = hashlib.sha256(tx).digest()
+            code = (deliver_results[i].code
+                    if deliver_results and i < len(deliver_results) else 0)
+            if code == 0:
+                self.cache.push(tx)
+            elif not self.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            self._remove(key)
+        self._purge_expired()
+        if self.recheck and self._txs:
+            self._recheck_txs()
+
+    def _purge_expired(self) -> None:
+        now = time.monotonic()
+        for key, w in list(self._txs.items()):
+            if self.ttl_num_blocks and self.height - w.height > self.ttl_num_blocks:
+                self._remove(key)
+                self.cache.remove(w.tx)
+            elif self.ttl_duration and now - w.time_s > self.ttl_duration:
+                self._remove(key)
+                self.cache.remove(w.tx)
+
+    def _recheck_txs(self) -> None:
+        for key, w in list(self._txs.items()):
+            res = self._proxy_app.check_tx(abci.RequestCheckTx(
+                tx=w.tx, type=abci.CHECK_TX_TYPE_RECHECK))
+            if res.code != 0:
+                self._remove(key)
+                if not self.keep_invalid_txs_in_cache:
+                    self.cache.remove(w.tx)
+            else:
+                w.priority = getattr(res, "priority", w.priority)
+                w.sort_key = (w.priority, -w.seq)
+
+    def flush(self) -> None:
+        self._txs.clear()
+        self._bytes = 0
+        self.tx_senders.clear()
+
+    # reactor iteration surface (mempool/reactor gossip)
+    def txs_snapshot(self) -> List[bytes]:
+        return [w.tx for w in sorted(self._txs.values(),
+                                     key=lambda w: (-w.priority, w.seq))]

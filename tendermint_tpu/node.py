@@ -1,0 +1,321 @@
+"""Node assembly: wires storage → ABCI proxy → handshake → reactors →
+switch → RPC from a Config (reference node/node.go:706 NewNode DI assembly,
+:941 OnStart ordering, :100 DefaultNewNode).
+
+Usage:
+    node = Node.default(config)     # loads node key, FilePV, genesis
+    await node.start()              # transport listen, dial peers, RPC
+    ...
+    await node.stop()
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Dict, List, Optional
+
+from .abci.application import Application
+from .abci.example.kvstore import KVStoreApplication
+from .blockchain.reactor import BlockchainReactor
+from .config import Config
+from .consensus import ConsensusState, WAL
+from .consensus.reactor import ConsensusReactor
+from .consensus.replay import Handshaker
+from .evidence.pool import EvidencePool
+from .evidence.reactor import EvidenceReactor
+from .libs.db import DB, MemDB, SQLiteDB
+from .mempool import CListMempool
+from .mempool.reactor import MempoolReactor
+from .p2p import NodeInfo, NodeKey, Switch, TCPTransport, parse_peer_list
+from .p2p.conn.mconnection import MConnConfig
+from .privval.file_pv import FilePV
+from .proxy import AppConns, local_client_creator, socket_client_creator
+from .state import BlockExecutor, StateStore, state_from_genesis
+from .store import BlockStore
+from .types import GenesisDoc
+from .types.event_bus import EventBus
+from .types.priv_validator import PrivValidator
+
+logger = logging.getLogger("tmtpu.node")
+
+# built-in ABCI apps resolvable by name from config.base.proxy_app
+BUILTIN_APPS = {
+    "kvstore": KVStoreApplication,
+}
+
+
+def _make_db(backend: str, directory: str, name: str) -> DB:
+    if backend == "mem":
+        return MemDB()
+    os.makedirs(directory, exist_ok=True)
+    return SQLiteDB(os.path.join(directory, f"{name}.db"))
+
+
+class Node:
+    """(node/node.go:225 Node)"""
+
+    def __init__(self, config: Config, priv_validator: Optional[PrivValidator],
+                 node_key: NodeKey, genesis: GenesisDoc,
+                 app: Optional[Application] = None):
+        self.config = config
+        self.genesis = genesis
+        self.node_key = node_key
+
+        # -- databases (node.go:235 initDBs) --------------------------------
+        backend = config.base.db_backend
+        dbdir = config.db_dir()
+        self.block_store = BlockStore(_make_db(backend, dbdir, "blockstore"))
+        self.state_store = StateStore(_make_db(backend, dbdir, "state"))
+
+        # -- ABCI app + proxy (node.go:251) ---------------------------------
+        if app is not None:
+            creator = local_client_creator(app)
+        elif config.base.abci == "socket":
+            creator = socket_client_creator(config.base.proxy_app)
+        else:
+            app_cls = BUILTIN_APPS.get(config.base.proxy_app)
+            if app_cls is None:
+                raise ValueError(
+                    f"unknown built-in app {config.base.proxy_app!r}; pass an "
+                    "Application or use abci=socket")
+            app = app_cls()
+            creator = local_client_creator(app)
+        self.app = app
+        self.proxy_app = AppConns(creator)
+        self.proxy_app.start()
+
+        # -- state load + ABCI handshake (node.go:725,777) ------------------
+        state = state_from_genesis(genesis)
+        loaded = self.state_store.load()
+        if loaded is not None:
+            state = loaded
+        self.event_bus = EventBus()
+        handshaker = Handshaker(self.state_store, state, self.block_store, genesis)
+        state = handshaker.handshake(self.proxy_app.consensus, self.proxy_app.query)
+        self.state_store.save(state)
+        self.initial_state = state
+
+        # -- mempool (node.go:368) ------------------------------------------
+        self.mempool = CListMempool(self.proxy_app.mempool,
+                                    height=state.last_block_height)
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, broadcast=config.mempool.broadcast)
+
+        # -- evidence (node.go:424) -----------------------------------------
+        self.evidence_pool = EvidencePool(
+            _make_db(backend, dbdir, "evidence"), self.state_store, self.block_store)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        # -- block executor --------------------------------------------------
+        self.block_exec = BlockExecutor(
+            self.state_store, self.proxy_app.consensus, self.mempool,
+            self.evidence_pool, self.block_store, self.event_bus)
+
+        # -- consensus (node.go:465) ----------------------------------------
+        wal_path = config.wal_file()
+        os.makedirs(os.path.dirname(wal_path), exist_ok=True)
+        wal = WAL(wal_path)
+        self.consensus_state = ConsensusState(
+            config.consensus, state, self.block_exec, self.block_store,
+            evpool=self.evidence_pool, wal=wal)
+        self.consensus_state.set_event_bus(self.event_bus)
+        if priv_validator is not None:
+            self.consensus_state.set_priv_validator(priv_validator)
+        self.priv_validator = priv_validator
+        self.mempool.tx_available_callbacks.append(
+            self.consensus_state.notify_txs_available)
+
+        # fast sync only makes sense with peers and an existing chain; when
+        # state sync is pending, block sync must NOT start at genesis — it
+        # enters later via switch_to_fast_sync at the bootstrapped height
+        state_sync_pending = (config.statesync.enable
+                              and state.last_block_height == 0)
+        fast_sync = (config.base.fast_sync and bool(config.p2p.persistent_peers)
+                     and not state_sync_pending)
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, wait_sync=fast_sync or state_sync_pending)
+
+        # -- block sync (node.go:443) ---------------------------------------
+        self.fatal_event = asyncio.Event()
+        self.fatal_error: Optional[BaseException] = None
+
+        def _on_fatal(exc: BaseException) -> None:
+            # deterministic local fault: reference panics; we signal the
+            # operator loop (cmd start exits non-zero) and stop accepting
+            self.fatal_error = exc
+            self.fatal_event.set()
+
+        self.blockchain_reactor = BlockchainReactor(
+            state, self.block_exec, self.block_store, fast_sync,
+            consensus_reactor=self.consensus_reactor, on_fatal=_on_fatal)
+        self._fast_sync = fast_sync
+
+        # -- tx/block indexer (node.go:745 createAndStartIndexerService) ----
+        self.indexer_service = None
+        self.tx_indexer = None
+        self.block_indexer = None
+        if config.tx_index.indexer == "kv":
+            from .state.txindex import IndexerService, KVBlockIndexer, KVTxIndexer
+
+            self.tx_indexer = KVTxIndexer(_make_db(backend, dbdir, "tx_index"))
+            self.block_indexer = KVBlockIndexer(
+                _make_db(backend, dbdir, "block_index"))
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus)
+
+        # -- state sync (node.go:839) ---------------------------------------
+        from .statesync import StateSyncReactor
+
+        self.statesync_reactor = StateSyncReactor(
+            self.proxy_app.snapshot, self.proxy_app.query)
+        self._state_sync = state_sync_pending
+
+        # -- transport + switch (node.go:498,567) ---------------------------
+        reactors = {
+            "MEMPOOL": self.mempool_reactor,
+            "BLOCKCHAIN": self.blockchain_reactor,
+            "CONSENSUS": self.consensus_reactor,
+            "EVIDENCE": self.evidence_reactor,
+            "STATESYNC": self.statesync_reactor,
+        }
+        descs = []
+        for r in reactors.values():
+            descs.extend(r.get_channels())
+        self.node_info = NodeInfo(
+            node_id=node_key.id,
+            network=genesis.chain_id,
+            channels=bytes(d.id for d in descs),
+            moniker=config.base.moniker,
+            rpc_address=config.rpc.laddr,
+        )
+        mconn_cfg = MConnConfig(
+            send_rate=config.p2p.send_rate, recv_rate=config.p2p.recv_rate,
+            max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
+            flush_throttle=config.p2p.flush_throttle_timeout)
+        self.transport = TCPTransport(node_key, self.node_info, descs, mconn_cfg)
+        self.switch = Switch(node_key.id, transport=self.transport)
+        for name, r in reactors.items():
+            self.switch.add_reactor(name, r)
+
+        # -- RPC --------------------------------------------------------------
+        self.rpc_server = None
+        if config.rpc.laddr:
+            from .rpc.server import RPCServer
+
+            self.rpc_server = RPCServer(self)
+
+        self.listen_addr = None
+        self._started = False
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def default(cls, config: Config, app: Optional[Application] = None) -> "Node":
+        """(node.go:100 DefaultNewNode) load node key / FilePV / genesis —
+        or, with priv_validator_laddr set, listen for a remote signer
+        (node.go:753 createAndStartPrivValidatorSocketClient)."""
+        node_key = NodeKey.load_or_gen(config.node_key_file())
+        genesis = GenesisDoc.from_file(config.genesis_file())
+        pv: Optional[PrivValidator]
+        if config.base.priv_validator_laddr:
+            from .privval.signer import SignerClient, SignerListenerEndpoint
+
+            addr = config.base.priv_validator_laddr.split("://", 1)[-1]
+            host, _, port = addr.rpartition(":")
+            endpoint = SignerListenerEndpoint(host or "127.0.0.1", int(port))
+            endpoint.wait_for_signer()
+            pv = SignerClient(endpoint, genesis.chain_id)
+            pv.get_pub_key()  # fail fast if the signer is broken
+        else:
+            key_file = config.priv_validator_key_file()
+            state_file = config.priv_validator_state_file()
+            if os.path.exists(key_file):
+                pv = FilePV.load(key_file, state_file)
+            else:
+                pv = FilePV.generate(key_file, state_file)
+                pv.save()
+        return cls(config, pv, node_key, genesis, app=app)
+
+    # -- lifecycle (node.go:941 OnStart) -------------------------------------
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        if self.indexer_service is not None:
+            await self.indexer_service.start()
+        if self.rpc_server is not None:
+            await self.rpc_server.start(self.config.rpc.laddr)
+        await self.switch.start()
+        host, port = _parse_laddr(self.config.p2p.laddr)
+        self.listen_addr = await self.switch.listen(host, port)
+        if self._state_sync:
+            self._statesync_task = asyncio.create_task(self._run_state_sync())
+        elif not self._fast_sync:
+            # WAL catchup for the in-flight height BEFORE the state machine
+            # runs (consensus/state.go:299 OnStart → replay.go:93): replays
+            # our own signed msgs so restart doesn't trip double-sign
+            # protection by re-signing an already-signed proposal/vote.
+            from .consensus.replay import catchup_replay
+
+            catchup_replay(self.consensus_state,
+                           self.consensus_state.rs.height)
+            await self.consensus_state.start()
+        # (fast-sync case: Switch.start() already started the reactor)
+        if self.config.p2p.persistent_peers:
+            peers = parse_peer_list(self.config.p2p.persistent_peers)
+            self.switch.dial_peers_async(peers, persistent=True)
+        logger.info("node %s started: p2p=%s rpc=%s", self.node_key.id[:8],
+                    self.listen_addr, self.config.rpc.laddr or "off")
+
+    async def _run_state_sync(self) -> None:
+        """(node.go:648 startStateSync) snapshot restore → bootstrap stores →
+        hand off to fast sync."""
+        from .light.client import TrustOptions
+        from .rpc.client import HTTPClient
+        from .statesync import LightClientStateProvider
+
+        cfg = self.config.statesync
+        try:
+            clients = [HTTPClient(s) for s in cfg.rpc_servers]
+            provider = LightClientStateProvider(
+                self.genesis.chain_id, self.genesis, clients,
+                TrustOptions(cfg.trust_period, cfg.trust_height,
+                             bytes.fromhex(cfg.trust_hash)))
+            state, commit = await self.statesync_reactor.sync(
+                provider, cfg.discovery_time)
+            self.state_store.bootstrap(state)
+            self.block_store.save_seen_commit(state.last_block_height, commit)
+            # consensus catches up via the fast-sync handoff
+            # (switch_to_consensus → reconstruct_last_commit + update_to_state)
+            logger.info("state sync complete at height %d; entering fast sync",
+                        state.last_block_height)
+            await self.blockchain_reactor.switch_to_fast_sync(state)
+        except Exception as e:
+            logger.critical("state sync failed: %s", e)
+            self.fatal_error = e
+            self.fatal_event.set()
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        task = getattr(self, "_statesync_task", None)
+        if task is not None and not task.done():
+            task.cancel()
+        await self.consensus_state.stop()
+        if self.indexer_service is not None:
+            await self.indexer_service.stop()
+        await self.switch.stop()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        self.proxy_app.stop()
+
+
+def _parse_laddr(laddr: str):
+    """tcp://host:port -> (host, port)"""
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)

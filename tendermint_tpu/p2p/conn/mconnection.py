@@ -111,6 +111,8 @@ class MConnection:
         self._raw_sends: set = set()
         self._send_budget = float(self.config.send_rate)
         self._budget_at = time.monotonic()
+        self._recv_budget = float(self.config.recv_rate)
+        self._recv_budget_at = time.monotonic()
         self._stopped = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -209,10 +211,21 @@ class MConnection:
 
     # -- receiving -----------------------------------------------------------
 
+    async def _recv_throttle(self, nbytes: int) -> None:
+        now = time.monotonic()
+        self._recv_budget = min(
+            float(self.config.recv_rate),
+            self._recv_budget + (now - self._recv_budget_at) * self.config.recv_rate)
+        self._recv_budget_at = now
+        self._recv_budget -= nbytes
+        if self._recv_budget < 0:
+            await asyncio.sleep(-self._recv_budget / self.config.recv_rate)
+
     async def _recv_routine(self) -> None:
         try:
             while not self._stopped:
                 msg = await self.conn.read_msg()
+                await self._recv_throttle(len(msg))
                 ln, pos = pw.decode_varint(msg, 0)
                 body = msg[pos:pos + ln]
                 fields = pw.fields_dict(body)

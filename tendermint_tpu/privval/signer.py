@@ -1,0 +1,261 @@
+"""Remote signer protocol (reference privval/signer_client.go,
+signer_listener_endpoint.go, signer_dialer_endpoint.go, signer_server.go —
+the tmkms integration surface).
+
+Topology matches the reference: the NODE listens on
+``priv_validator_laddr``; the SIGNER process dials in and then serves
+signing requests over that single connection. Messages are
+length-delimited protobuf (proto/tendermint/privval/types.proto oneof):
+
+    1 PubKeyRequest{chain_id}        2 PubKeyResponse{pub_key, error}
+    3 SignVoteRequest{vote, chain_id}     4 SignedVoteResponse{vote, error}
+    5 SignProposalRequest{proposal, ...}  6 SignedProposalResponse{...}
+    7 PingRequest                    8 PingResponse
+
+Blocking sockets on background threads, mirroring the reference's blocking
+call discipline: consensus' synchronous sign_vote/sign_proposal calls block
+until the signer answers (or time out).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Optional, Tuple
+
+from ..crypto import Ed25519PubKey, PubKey
+from ..libs import protowire as pw
+from ..types.priv_validator import PrivValidator
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+logger = logging.getLogger("tmtpu.privval.signer")
+
+DEFAULT_TIMEOUT = 5.0
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+# -- wire ---------------------------------------------------------------------
+
+def _frame(field: int, body: bytes) -> bytes:
+    w = pw.Writer()
+    w.message(field, body)
+    return pw.length_delimited(w.finish())
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[int, bytes]:
+    length = 0
+    shift = 0
+    while True:
+        b = sock.recv(1)
+        if not b:
+            raise ConnectionError("signer connection closed")
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+    data = b""
+    while len(data) < length:
+        chunk = sock.recv(length - len(data))
+        if not chunk:
+            raise ConnectionError("signer connection closed mid-message")
+        data += chunk
+    for fn, _wt, v in pw.iter_fields(data):
+        return fn, v
+    raise RemoteSignerError("empty privval message")
+
+
+def _err_body(msg: str) -> bytes:
+    w = pw.Writer()
+    w.varint(1, 1)
+    w.string(2, msg)
+    return w.finish()
+
+
+# -- signer side (dials the node; privval/signer_server.go) -------------------
+
+class SignerServer:
+    """Runs next to the key: dials the node and serves its FilePV."""
+
+    def __init__(self, pv: PrivValidator, chain_id: str, addr: Tuple[str, int]):
+        self.pv = pv
+        self.chain_id = chain_id
+        self.addr = addr
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="signer-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._sock = socket.create_connection(self.addr, timeout=5.0)
+                self._sock.settimeout(None)
+                logger.info("signer connected to %s:%d", *self.addr)
+                self._serve(self._sock)
+            except (ConnectionError, OSError) as e:
+                if self._stopped.is_set():
+                    return
+                logger.warning("signer connection lost (%s); redialing", e)
+                self._stopped.wait(1.0)
+
+    def _serve(self, sock: socket.socket) -> None:
+        while not self._stopped.is_set():
+            fn, body = _recv_msg(sock)
+            sock.sendall(self._handle(fn, body))
+
+    def _handle(self, fn: int, body: bytes) -> bytes:
+        fields = pw.fields_dict(body) if body else {}
+        if fn == 1:  # PubKeyRequest
+            pk = pw.Writer()
+            pk.bytes(1, self.pv.get_pub_key().bytes())
+            resp = pw.Writer()
+            resp.message(1, pk.finish())
+            return _frame(2, resp.finish())
+        if fn == 3:  # SignVoteRequest
+            try:
+                vote = Vote.decode(fields[1][0])
+                chain_id = fields.get(2, [b""])[0].decode() or self.chain_id
+                self.pv.sign_vote(chain_id, vote)
+                resp = pw.Writer()
+                resp.message(1, vote.encode())
+                return _frame(4, resp.finish())
+            except Exception as e:
+                resp = pw.Writer()
+                resp.message(2, _err_body(str(e)))
+                return _frame(4, resp.finish())
+        if fn == 5:  # SignProposalRequest
+            try:
+                proposal = Proposal.decode(fields[1][0])
+                chain_id = fields.get(2, [b""])[0].decode() or self.chain_id
+                self.pv.sign_proposal(chain_id, proposal)
+                resp = pw.Writer()
+                resp.message(1, proposal.encode())
+                return _frame(6, resp.finish())
+            except Exception as e:
+                resp = pw.Writer()
+                resp.message(2, _err_body(str(e)))
+                return _frame(6, resp.finish())
+        if fn == 7:  # PingRequest
+            return _frame(8, b"")
+        resp = pw.Writer()
+        resp.message(2, _err_body(f"unknown request {fn}"))
+        return _frame(fn + 1, resp.finish())
+
+
+# -- node side (listens; privval/signer_listener_endpoint.go + client) --------
+
+class SignerListenerEndpoint:
+    """Accepts the signer's inbound connection on priv_validator_laddr."""
+
+    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT):
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._conn: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self.timeout = timeout
+        self._stopped = False
+
+    def wait_for_signer(self, timeout: float = 30.0) -> None:
+        self._listener.settimeout(timeout)
+        conn, addr = self._listener.accept()
+        conn.settimeout(self.timeout)
+        self._conn = conn
+        logger.info("remote signer connected from %s", addr)
+
+    def request(self, framed: bytes) -> Tuple[int, bytes]:
+        with self._lock:  # one in-flight request (reference serializes too)
+            if self._conn is None:
+                raise RemoteSignerError("no signer connected")
+            self._conn.sendall(framed)
+            return _recv_msg(self._conn)
+
+    def close(self) -> None:
+        self._stopped = True
+        for s in (self._conn, self._listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class SignerClient(PrivValidator):
+    """PrivValidator over a SignerListenerEndpoint
+    (privval/signer_client.go)."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self._pub: Optional[PubKey] = None
+
+    def get_pub_key(self) -> PubKey:
+        if self._pub is None:
+            w = pw.Writer()
+            w.string(1, self.chain_id)
+            fn, body = self.endpoint.request(_frame(1, w.finish()))
+            if fn != 2:
+                raise RemoteSignerError(f"unexpected response {fn}")
+            fields = pw.fields_dict(body)
+            if 2 in fields:
+                raise RemoteSignerError(_err_text(fields[2][0]))
+            pk_fields = pw.fields_dict(fields[1][0])
+            self._pub = Ed25519PubKey(pk_fields[1][0])
+        return self._pub
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        w = pw.Writer()
+        w.message(1, vote.encode())
+        w.string(2, chain_id)
+        fn, body = self.endpoint.request(_frame(3, w.finish()))
+        if fn != 4:
+            raise RemoteSignerError(f"unexpected response {fn}")
+        fields = pw.fields_dict(body)
+        if 2 in fields:
+            raise RemoteSignerError(_err_text(fields[2][0]))
+        signed = Vote.decode(fields[1][0])
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        w = pw.Writer()
+        w.message(1, proposal.encode())
+        w.string(2, chain_id)
+        fn, body = self.endpoint.request(_frame(5, w.finish()))
+        if fn != 6:
+            raise RemoteSignerError(f"unexpected response {fn}")
+        fields = pw.fields_dict(body)
+        if 2 in fields:
+            raise RemoteSignerError(_err_text(fields[2][0]))
+        signed = Proposal.decode(fields[1][0])
+        proposal.signature = signed.signature
+        proposal.timestamp_ns = signed.timestamp_ns
+
+    def ping(self) -> bool:
+        try:
+            fn, _ = self.endpoint.request(_frame(7, b""))
+            return fn == 8
+        except Exception:
+            return False
+
+
+def _err_text(body: bytes) -> str:
+    fields = pw.fields_dict(body)
+    raw = fields.get(2, [b""])[0]
+    return raw.decode() if isinstance(raw, bytes) else str(raw)

@@ -1,0 +1,5 @@
+"""External API surface: JSON-RPC over HTTP + WebSocket subscriptions
+(reference rpc/ — core route table rpc/core/routes.go:10-49, jsonrpc server
+rpc/jsonrpc/server/, clients rpc/client/)."""
+
+from .core import Environment  # noqa: F401

@@ -1,0 +1,132 @@
+"""RPC clients (reference rpc/client/):
+
+* :class:`HTTPClient` — remote JSON-RPC over HTTP + WS subscriptions
+  (rpc/jsonrpc/client/http_json_client.go, ws_client.go);
+* :class:`LocalClient` — direct in-proc calls against a node's Environment
+  (rpc/client/local — used by tests and the light-client provider).
+
+Both expose the same ``await client.call("block", height=5)`` surface plus
+typed convenience wrappers for the routes the framework itself consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+from typing import Any, AsyncIterator, Dict, Optional
+
+import aiohttp
+
+from .core import Environment, RPCError
+
+
+class HTTPClient:
+    def __init__(self, base_url: str):
+        # accept tcp://host:port or http://host:port
+        if base_url.startswith("tcp://"):
+            base_url = "http://" + base_url[len("tcp://"):]
+        self.base_url = base_url.rstrip("/")
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._ids = itertools.count(1)
+
+    async def _ensure(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def call(self, method: str, **params) -> Any:
+        session = await self._ensure()
+        payload = {"jsonrpc": "2.0", "id": next(self._ids),
+                   "method": method, "params": params}
+        async with session.post(self.base_url + "/", json=payload) as resp:
+            doc = await resp.json()
+        if doc.get("error"):
+            e = doc["error"]
+            raise RPCError(e.get("code", -1), e.get("message", ""),
+                           e.get("data", ""))
+        return doc["result"]
+
+    async def subscribe(self, query: str) -> AsyncIterator[Dict[str, Any]]:
+        """Async iterator of events from the /websocket endpoint."""
+        session = await self._ensure()
+        ws = await session.ws_connect(self.base_url + "/websocket")
+        await ws.send_json({"jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                            "params": {"query": query}})
+        first = json.loads((await ws.receive()).data)  # subscribe ack
+        if first.get("error"):
+            raise RPCError(-1, str(first["error"]))
+
+        async def gen():
+            try:
+                async for msg in ws:
+                    doc = json.loads(msg.data)
+                    if doc.get("result"):
+                        yield doc["result"]
+            finally:
+                await ws.close()
+        return gen()
+
+    # typed helpers ----------------------------------------------------------
+
+    async def status(self) -> Dict[str, Any]:
+        return await self.call("status")
+
+    async def block(self, height: Optional[int] = None) -> Dict[str, Any]:
+        return await self.call("block", **({"height": height} if height else {}))
+
+    async def commit(self, height: Optional[int] = None) -> Dict[str, Any]:
+        return await self.call("commit", **({"height": height} if height else {}))
+
+    async def validators(self, height: Optional[int] = None, page: int = 1,
+                         per_page: int = 100) -> Dict[str, Any]:
+        params = {"page": page, "per_page": per_page}
+        if height:
+            params["height"] = height
+        return await self.call("validators", **params)
+
+    async def broadcast_tx_commit(self, tx: bytes) -> Dict[str, Any]:
+        import base64
+        return await self.call("broadcast_tx_commit",
+                               tx=base64.b64encode(tx).decode())
+
+    async def abci_query(self, path: str, data: bytes) -> Dict[str, Any]:
+        return await self.call("abci_query", path=path, data=data.hex())
+
+
+class LocalClient:
+    """In-proc client: same interface, zero sockets (rpc/client/local)."""
+
+    def __init__(self, node):
+        self.env = Environment(node)
+        self.node = node
+
+    async def call(self, method: str, **params) -> Any:
+        handler = getattr(self.env, method, None)
+        if handler is None:
+            raise RPCError(-32601, f"method {method!r} not found")
+        return await handler(**params)
+
+    async def status(self):
+        return await self.call("status")
+
+    async def block(self, height=None):
+        return await self.call("block", **({"height": height} if height else {}))
+
+    async def commit(self, height=None):
+        return await self.call("commit", **({"height": height} if height else {}))
+
+    async def validators(self, height=None, page=1, per_page=100):
+        params = {"page": page, "per_page": per_page}
+        if height:
+            params["height"] = height
+        return await self.call("validators", **params)
+
+    async def broadcast_tx_commit(self, tx: bytes):
+        import base64
+        return await self.call("broadcast_tx_commit",
+                               tx=base64.b64encode(tx).decode())

@@ -1,0 +1,432 @@
+"""Core RPC route handlers over node internals
+(reference rpc/core/ — route table routes.go:10-49, env.go Environment).
+
+Every handler is an async method returning a JSON-serializable dict; the
+server layer (server.py) maps JSON-RPC / URI calls onto them, and the local
+client (client.py LocalClient) calls them directly in-proc (the reference's
+rpc/client/local pattern, used by tests and the light-client provider).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from typing import Any, Dict, List, Optional
+
+from ..types import events as tme
+from .json_enc import (
+    b64,
+    enc_block,
+    enc_block_id,
+    enc_commit,
+    enc_header,
+    enc_tx_result,
+    enc_validator,
+    hexu,
+    rfc3339,
+)
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class Environment:
+    """(rpc/core/env.go) Handlers reach node internals through this."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # -- info routes ---------------------------------------------------------
+
+    async def health(self) -> Dict[str, Any]:
+        return {}
+
+    async def status(self) -> Dict[str, Any]:
+        """(rpc/core/status.go)"""
+        node = self.node
+        latest_height = node.block_store.height()
+        meta = node.block_store.load_block_meta(latest_height)
+        earliest = node.block_store.base()
+        emeta = node.block_store.load_block_meta(earliest)
+        pub = None
+        if node.priv_validator is not None:
+            pub = node.priv_validator.get_pub_key()
+        cs = node.consensus_state
+        return {
+            "node_info": {
+                "id": node.node_key.id,
+                "listen_addr": node.node_info.listen_addr,
+                "network": node.genesis.chain_id,
+                "version": node.node_info.version,
+                "moniker": node.config.base.moniker,
+                "protocol_version": {
+                    "p2p": str(node.node_info.protocol_p2p),
+                    "block": str(node.node_info.protocol_block),
+                    "app": str(node.node_info.protocol_app),
+                },
+            },
+            "sync_info": {
+                "latest_block_hash": hexu(meta.block_id.hash if meta else b""),
+                "latest_app_hash": hexu(cs.state.app_hash),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": (rfc3339(meta.header.time_ns)
+                                      if meta else ""),
+                "earliest_block_height": str(earliest),
+                "earliest_block_hash": hexu(emeta.block_id.hash if emeta else b""),
+                "catching_up": not node.blockchain_reactor.synced.is_set()
+                if node._fast_sync else False,
+            },
+            "validator_info": {
+                "address": hexu(pub.address()) if pub else "",
+                "pub_key": {"type": "tendermint/PubKeyEd25519",
+                            "value": b64(pub.bytes())} if pub else None,
+                "voting_power": str(self._voting_power(pub)),
+            },
+        }
+
+    def _voting_power(self, pub) -> int:
+        if pub is None:
+            return 0
+        vals = self.node.consensus_state.state.validators
+        idx, val = vals.get_by_address(pub.address())
+        return val.voting_power if val else 0
+
+    async def net_info(self) -> Dict[str, Any]:
+        sw = self.node.switch
+        peers = []
+        for p in sw.peers.values():
+            info = getattr(p, "node_info", None)
+            peers.append({
+                "node_info": {
+                    "id": p.id,
+                    "moniker": getattr(info, "moniker", ""),
+                    "network": getattr(info, "network", ""),
+                    "listen_addr": getattr(info, "listen_addr", ""),
+                },
+                "is_outbound": p.outbound,
+                "remote_ip": getattr(getattr(p, "socket_addr", None), "host", ""),
+            })
+        return {
+            "listening": sw.transport is not None,
+            "listeners": [str(self.node.listen_addr)] if self.node.listen_addr else [],
+            "n_peers": str(len(peers)),
+            "peers": peers,
+        }
+
+    async def genesis(self) -> Dict[str, Any]:
+        import json as _json
+
+        return {"genesis": _json.loads(self.node.genesis.to_json())}
+
+    # -- blockchain routes ---------------------------------------------------
+
+    def _height_or_latest(self, height: Optional[int]) -> int:
+        store = self.node.block_store
+        if height is None or int(height) <= 0:
+            return store.height()
+        h = int(height)
+        if h > store.height():
+            raise RPCError(-32603, f"height {h} must be <= {store.height()}")
+        if h < store.base():
+            raise RPCError(-32603, f"height {h} is below base {store.base()}")
+        return h
+
+    async def blockchain(self, min_height: int = 0, max_height: int = 0
+                         ) -> Dict[str, Any]:
+        """(rpc/core/blocks.go BlockchainInfo) newest-first headers, cap 20."""
+        store = self.node.block_store
+        maxh = int(max_height) or store.height()
+        maxh = min(maxh, store.height())
+        minh = max(int(min_height) or store.base(), store.base())
+        minh = max(minh, maxh - 19)
+        metas = []
+        for h in range(maxh, minh - 1, -1):
+            m = store.load_block_meta(h)
+            if m is None:
+                continue
+            metas.append({
+                "block_id": enc_block_id(m.block_id),
+                "block_size": str(m.block_size),
+                "header": enc_header(m.header),
+                "num_txs": str(m.num_txs),
+            })
+        return {"last_height": str(store.height()), "block_metas": metas}
+
+    async def block(self, height: Optional[int] = None) -> Dict[str, Any]:
+        h = self._height_or_latest(height)
+        blk = self.node.block_store.load_block(h)
+        meta = self.node.block_store.load_block_meta(h)
+        if blk is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        return {"block_id": enc_block_id(meta.block_id), "block": enc_block(blk)}
+
+    async def block_by_hash(self, hash: str) -> Dict[str, Any]:
+        blk = self.node.block_store.load_block_by_hash(bytes.fromhex(hash))
+        if blk is None:
+            return {"block_id": enc_block_id(None), "block": None}
+        meta = self.node.block_store.load_block_meta(blk.header.height)
+        return {"block_id": enc_block_id(meta.block_id), "block": enc_block(blk)}
+
+    async def commit(self, height: Optional[int] = None) -> Dict[str, Any]:
+        """(rpc/core/blocks.go Commit) header + its canonical commit."""
+        h = self._height_or_latest(height)
+        store = self.node.block_store
+        meta = store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no header at height {h}")
+        if h == store.height():
+            commit = store.load_seen_commit(h)
+            canonical = False
+        else:
+            commit = store.load_block_commit(h)
+            canonical = True
+        return {
+            "signed_header": {"header": enc_header(meta.header),
+                              "commit": enc_commit(commit)},
+            "canonical": canonical,
+        }
+
+    async def block_results(self, height: Optional[int] = None) -> Dict[str, Any]:
+        h = self._height_or_latest(height)
+        resp = self.node.state_store.load_abci_responses(h)
+        if resp is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": str(h),
+            "txs_results": [enc_tx_result(r) for r in resp.deliver_txs],
+            "begin_block_events": [],
+            "end_block_events": [],
+            "validator_updates": [],
+            "consensus_param_updates": None,
+        }
+
+    async def validators(self, height: Optional[int] = None, page: int = 1,
+                         per_page: int = 30) -> Dict[str, Any]:
+        h = self._height_or_latest(height)
+        vals = self.node.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        allv = vals.validators
+        page, per_page = max(1, int(page)), min(100, int(per_page))
+        start = (page - 1) * per_page
+        sel = allv[start:start + per_page]
+        return {
+            "block_height": str(h),
+            "validators": [enc_validator(v) for v in sel],
+            "count": str(len(sel)),
+            "total": str(len(allv)),
+        }
+
+    async def consensus_state(self) -> Dict[str, Any]:
+        rs = self.node.consensus_state.rs
+        return {"round_state": {
+            "height/round/step": f"{rs.height}/{rs.round}/{int(rs.step)}",
+            "height": str(rs.height), "round": rs.round, "step": int(rs.step),
+            "proposal_block_hash": hexu(
+                rs.proposal_block.hash() if rs.proposal_block else b""),
+        }}
+
+    async def consensus_params(self, height: Optional[int] = None) -> Dict[str, Any]:
+        h = self._height_or_latest(height)
+        params = self.node.state_store.load_consensus_params(h)
+        if params is None:
+            params = self.node.consensus_state.state.consensus_params
+        return {"block_height": str(h), "consensus_params": {
+            "block": {"max_bytes": str(params.block.max_bytes),
+                      "max_gas": str(params.block.max_gas)},
+            "evidence": {"max_age_num_blocks": str(params.evidence.max_age_num_blocks)},
+        }}
+
+    # -- ABCI ----------------------------------------------------------------
+
+    async def abci_info(self) -> Dict[str, Any]:
+        from ..abci import types as abci
+
+        resp = self.node.proxy_app.query.info(abci.RequestInfo())
+        return {"response": {
+            "data": resp.data, "version": resp.version,
+            "app_version": str(resp.app_version),
+            "last_block_height": str(resp.last_block_height),
+            "last_block_app_hash": b64(resp.last_block_app_hash),
+        }}
+
+    async def abci_query(self, path: str = "", data: str = "",
+                         height: int = 0, prove: bool = False) -> Dict[str, Any]:
+        from ..abci import types as abci
+
+        resp = self.node.proxy_app.query.query(abci.RequestQuery(
+            data=bytes.fromhex(data) if data else b"",
+            path=path, height=int(height), prove=bool(prove)))
+        return {"response": {
+            "code": resp.code, "log": resp.log, "info": resp.info,
+            "index": str(resp.index), "key": b64(resp.key),
+            "value": b64(resp.value), "height": str(resp.height),
+            "codespace": resp.codespace,
+        }}
+
+    # -- mempool / broadcast (rpc/core/mempool.go) ---------------------------
+
+    async def unconfirmed_txs(self, limit: int = 30) -> Dict[str, Any]:
+        txs = self.node.mempool.reap_max_txs(int(limit))
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(self.node.mempool.size()),
+            "total_bytes": str(sum(len(t) for t in txs)),
+            "txs": [b64(t) for t in txs],
+        }
+
+    async def num_unconfirmed_txs(self) -> Dict[str, Any]:
+        return {
+            "n_txs": str(self.node.mempool.size()),
+            "total": str(self.node.mempool.size()),
+            "total_bytes": "0",
+        }
+
+    async def broadcast_tx_async(self, tx: str) -> Dict[str, Any]:
+        raw = _decode_tx_param(tx)
+        asyncio.get_running_loop().call_soon(self.node.mempool.check_tx, raw)
+        return {"code": 0, "data": "", "log": "", "codespace": "",
+                "hash": hexu(hashlib.sha256(raw).digest())}
+
+    async def broadcast_tx_sync(self, tx: str) -> Dict[str, Any]:
+        raw = _decode_tx_param(tx)
+        res = self.node.mempool.check_tx(raw)
+        return {"code": res.code, "data": b64(res.data), "log": res.log,
+                "codespace": getattr(res, "codespace", ""),
+                "hash": hexu(hashlib.sha256(raw).digest())}
+
+    async def broadcast_tx_commit(self, tx: str) -> Dict[str, Any]:
+        """(rpc/core/mempool.go:64) CheckTx, then wait for the DeliverTx
+        event with this tx's hash, bounded by timeout_broadcast_tx_commit."""
+        raw = _decode_tx_param(tx)
+        tx_hash = hashlib.sha256(raw).digest()
+        bus = self.node.event_bus
+        sub_id = f"rpc-btc-{tx_hash.hex()[:16]}-{time.monotonic_ns()}"
+        query = (f"{tme.EVENT_TYPE_KEY}='{tme.EVENT_TX}' AND "
+                 f"{tme.TX_HASH_KEY}='{tx_hash.hex().upper()}'")
+        sub = bus.subscribe(sub_id, query)
+        try:
+            check = self.node.mempool.check_tx(raw)
+            if check.code != 0:
+                return {
+                    "check_tx": enc_tx_result(check),
+                    "deliver_tx": enc_tx_result(_EmptyResult()),
+                    "hash": hexu(tx_hash), "height": "0",
+                }
+            timeout = self.node.config.rpc.timeout_broadcast_tx_commit
+            try:
+                msg = await asyncio.wait_for(sub.next(), timeout)
+            except asyncio.TimeoutError:
+                raise RPCError(-32603, "timed out waiting for tx to be included "
+                                       "in a block")
+            ev = msg.data
+            return {
+                "check_tx": enc_tx_result(check),
+                "deliver_tx": enc_tx_result(ev.result),
+                "hash": hexu(tx_hash),
+                "height": str(ev.height),
+            }
+        finally:
+            bus.unsubscribe_all(sub_id)
+
+    async def broadcast_evidence(self, evidence: Dict[str, Any]) -> Dict[str, Any]:
+        raise RPCError(-32603, "evidence decoding over RPC not supported yet")
+
+    # -- indexer routes (rpc/core/tx.go, blocks.go BlockSearch) --------------
+
+    def _tx_indexer(self):
+        idx = self.node.tx_indexer
+        if idx is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        return idx
+
+    async def tx(self, hash: str, prove: bool = False) -> Dict[str, Any]:
+        r = self._tx_indexer().get(bytes.fromhex(hash))
+        if r is None:
+            raise RPCError(-32603, f"tx {hash} not found")
+        return _enc_tx_search_result(r)
+
+    async def tx_search(self, query: str, prove: bool = False, page: int = 1,
+                        per_page: int = 30, order_by: str = "asc"
+                        ) -> Dict[str, Any]:
+        results = self._tx_indexer().search(query, limit=10000)
+        if order_by == "desc":
+            results = list(reversed(results))
+        page, per_page = max(1, int(page)), min(100, int(per_page))
+        start = (page - 1) * per_page
+        sel = results[start:start + per_page]
+        return {"txs": [_enc_tx_search_result(r) for r in sel],
+                "total_count": str(len(results))}
+
+    async def block_search(self, query: str, page: int = 1, per_page: int = 30,
+                           order_by: str = "asc") -> Dict[str, Any]:
+        idx = self.node.block_indexer
+        if idx is None:
+            raise RPCError(-32603, "block indexing is disabled")
+        heights = idx.search(query, limit=10000)
+        if order_by == "desc":
+            heights = list(reversed(heights))
+        page, per_page = max(1, int(page)), min(100, int(per_page))
+        sel = heights[(page - 1) * per_page:(page - 1) * per_page + per_page]
+        blocks = []
+        for h in sel:
+            blk = self.node.block_store.load_block(h)
+            meta = self.node.block_store.load_block_meta(h)
+            if blk is not None:
+                blocks.append({"block_id": enc_block_id(meta.block_id),
+                               "block": enc_block(blk)})
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
+
+class _EmptyResult:
+    code = 0
+    data = b""
+    log = ""
+    info = ""
+    gas_wanted = 0
+    gas_used = 0
+    codespace = ""
+
+
+def _decode_tx_param(tx: str) -> bytes:
+    """Accept base64 (JSON-RPC convention) or 0x-hex."""
+    import base64 as _b64
+
+    if isinstance(tx, bytes):
+        return tx
+    if tx.startswith("0x"):
+        return bytes.fromhex(tx[2:])
+    return _b64.b64decode(tx)
+
+
+# the route table (routes.go:10-49); name -> handler attribute
+ROUTES = [
+    "health", "status", "net_info", "genesis", "blockchain", "block",
+    "block_by_hash", "block_results", "commit", "validators",
+    "consensus_state", "consensus_params", "abci_info", "abci_query",
+    "unconfirmed_txs", "num_unconfirmed_txs", "broadcast_tx_async",
+    "broadcast_tx_sync", "broadcast_tx_commit", "broadcast_evidence",
+    "tx", "tx_search", "block_search",
+]
+
+
+def _enc_tx_search_result(r) -> Dict[str, Any]:
+    import hashlib as _h
+
+    return {
+        "hash": hexu(_h.sha256(r.tx).digest()),
+        "height": str(r.height),
+        "index": r.index,
+        "tx_result": {
+            "code": r.code, "data": b64(r.data), "log": r.log,
+            "gas_wanted": str(r.gas_wanted), "gas_used": str(r.gas_used),
+            "events": r.events,
+        },
+        "tx": b64(r.tx),
+    }

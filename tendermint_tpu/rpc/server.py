@@ -1,0 +1,200 @@
+"""JSON-RPC server: HTTP POST (JSON-RPC 2.0), GET URI routes, and the
+/websocket subscription endpoint (reference rpc/jsonrpc/server/ —
+http_json_handler.go, http_uri_handler.go, ws_handler.go:32).
+
+aiohttp-based; one server per node, bound to config.rpc.laddr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from aiohttp import WSMsgType, web
+
+from .core import Environment, ROUTES, RPCError
+
+logger = logging.getLogger("tmtpu.rpc")
+
+
+def _rpc_response(id_, result=None, error: Optional[RPCError] = None) -> Dict:
+    if error is not None:
+        return {"jsonrpc": "2.0", "id": id_,
+                "error": {"code": error.code, "message": error.message,
+                          "data": error.data}}
+    return {"jsonrpc": "2.0", "id": id_, "result": result}
+
+
+class RPCServer:
+    def __init__(self, node):
+        self.node = node
+        self.env = Environment(node)
+        self._runner: Optional[web.AppRunner] = None
+        self._subscriptions: Dict[str, list] = {}  # ws id -> [sub ids]
+
+    async def start(self, laddr: str) -> None:
+        app = web.Application(client_max_size=self.node.config.rpc.max_body_bytes)
+        app.router.add_post("/", self._handle_jsonrpc)
+        app.router.add_get("/websocket", self._handle_websocket)
+        for name in ROUTES:
+            app.router.add_get(f"/{name}", self._make_uri_handler(name))
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        host, port = _parse(laddr)
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.bound_port = self._runner.addresses[0][1] if self._runner.addresses else port
+        logger.info("RPC listening on %s:%s", host, self.bound_port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- JSON-RPC POST -------------------------------------------------------
+
+    async def _handle_jsonrpc(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                _rpc_response(None, error=RPCError(-32700, "parse error")),
+                status=500)
+        single = not isinstance(body, list)
+        reqs = [body] if single else body
+        out = []
+        for r in reqs:
+            out.append(await self._dispatch(r))
+        return web.json_response(out[0] if single else out)
+
+    async def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        id_ = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        if method not in ROUTES:
+            return _rpc_response(id_, error=RPCError(-32601,
+                                                     f"method {method!r} not found"))
+        handler = getattr(self.env, method)
+        try:
+            if isinstance(params, list):
+                result = await handler(*params)
+            else:
+                result = await handler(**params)
+            return _rpc_response(id_, result=result)
+        except RPCError as e:
+            return _rpc_response(id_, error=e)
+        except TypeError as e:
+            return _rpc_response(id_, error=RPCError(-32602, f"invalid params: {e}"))
+        except Exception as e:
+            logger.exception("rpc %s failed", method)
+            return _rpc_response(id_, error=RPCError(-32603, str(e)))
+
+    # -- GET URI -------------------------------------------------------------
+
+    def _make_uri_handler(self, name: str):
+        async def handler(request: web.Request) -> web.Response:
+            params = {}
+            for k, v in request.query.items():
+                params[k] = _coerce(k, v)
+            fake = {"id": -1, "method": name, "params": params}
+            return web.json_response(await self._dispatch(fake))
+        return handler
+
+    # -- WebSocket subscriptions (ws_handler.go:32) --------------------------
+
+    async def _handle_websocket(self, request: web.Request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        ws_id = f"ws-{id(ws)}"
+        pumps: list = []
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    req = json.loads(msg.data)
+                except json.JSONDecodeError:
+                    continue
+                method = req.get("method")
+                id_ = req.get("id")
+                params = req.get("params") or {}
+                if method == "subscribe":
+                    query = params.get("query", "")
+                    sub = self.node.event_bus.subscribe(ws_id, query)
+                    await ws.send_json(_rpc_response(id_, result={}))
+                    pumps.append(asyncio.create_task(
+                        self._pump(ws, id_, query, sub)))
+                elif method == "unsubscribe_all" or method == "unsubscribe":
+                    _quiet_unsubscribe(self.node.event_bus, ws_id)
+                    await ws.send_json(_rpc_response(id_, result={}))
+                else:
+                    await ws.send_json(await self._dispatch(req))
+        finally:
+            _quiet_unsubscribe(self.node.event_bus, ws_id)
+            for p in pumps:
+                p.cancel()
+        return ws
+
+    async def _pump(self, ws, id_, query: str, sub) -> None:
+        from ..libs.pubsub import SubscriptionCanceled
+        from ..types.event_bus import EventDataNewBlock, EventDataTx
+
+        try:
+            while True:
+                msg = await sub.next()
+                data = _encode_event_data(msg.data)
+                await ws.send_json(_rpc_response(id_, result={
+                    "query": query, "data": data,
+                    "events": msg.events,
+                }))
+        except (SubscriptionCanceled, ConnectionError, asyncio.CancelledError):
+            pass
+
+
+def _quiet_unsubscribe(bus, subscriber: str) -> None:
+    try:
+        bus.unsubscribe_all(subscriber)
+    except ValueError:
+        pass  # never subscribed
+
+
+def _encode_event_data(data) -> Dict[str, Any]:
+    from .json_enc import enc_block, enc_tx_result, b64
+    from ..types.event_bus import EventDataNewBlock, EventDataTx
+
+    if isinstance(data, EventDataNewBlock):
+        return {"type": "tendermint/event/NewBlock",
+                "value": {"block": enc_block(data.block)}}
+    if isinstance(data, EventDataTx):
+        return {"type": "tendermint/event/Tx",
+                "value": {"TxResult": {
+                    "height": str(data.height), "index": data.index,
+                    "tx": b64(data.tx), "result": enc_tx_result(data.result)}}}
+    return {"type": type(data).__name__, "value": {}}
+
+
+# URI params that are numeric; everything else stays a string (a hex "data"
+# param must not be swallowed by int())
+_NUMERIC_PARAMS = {"height", "page", "per_page", "limit", "min_height",
+                   "max_height"}
+
+
+def _coerce(key: str, v: str):
+    if v in ("true", "false"):
+        return v == "true"
+    if v.startswith('"') and v.endswith('"'):
+        return v[1:-1]
+    if key in _NUMERIC_PARAMS:
+        try:
+            return int(v)
+        except ValueError:
+            return v
+    return v
+
+
+def _parse(laddr: str):
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)

@@ -142,10 +142,15 @@ class StateStore:
     def bootstrap(self, state: State) -> None:
         """Seed stores from an out-of-band trusted state — state sync
         (state/store.go Bootstrap)."""
-        height = state.last_block_height
-        if height == 0:
+        # reference store.go Bootstrap: height := LastBlockHeight+1 (or
+        # InitialHeight at genesis); LastValidators validate block height-1,
+        # Validators block height, NextValidators block height+1; params for
+        # block height
+        height = state.last_block_height + 1
+        if height == 1:
             height = state.initial_height
-        if height > 0 and state.last_validators is not None and state.last_validators.size() > 0:
+        if height > 1 and state.last_validators is not None \
+                and state.last_validators.size() > 0:
             self._save_validators(height - 1, state.last_validators)
         self._save_validators(height, state.validators)
         self._save_validators(height + 1, state.next_validators)

@@ -1,0 +1,221 @@
+"""Tx + block event indexing (reference state/txindex/indexer_service.go,
+state/txindex/kv/kv.go, state/indexer/block/kv/):
+
+an IndexerService subscribes to the EventBus (Tx + NewBlockHeader events)
+and writes a KV index that powers the ``tx``, ``tx_search`` and
+``block_search`` RPC routes. Queries reuse the pubsub query language
+(libs/pubsub.Query — same grammar the reference compiles from query.peg).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..libs.db import DB
+from ..libs.pubsub import Query
+from ..types import events as tme
+
+logger = logging.getLogger("tmtpu.txindex")
+
+_TX_HASH_PREFIX = b"tx/h/"     # tx hash -> stored result
+_TX_EVENT_PREFIX = b"tx/e/"    # key/value/height/index -> tx hash
+_BLOCK_EVENT_PREFIX = b"blk/e/"  # key/value/height -> height
+
+
+@dataclass
+class TxResult:
+    """(proto abci.TxResult) what the kv indexer persists per tx."""
+
+    height: int
+    index: int
+    tx: bytes
+    code: int
+    data: bytes
+    log: str
+    gas_wanted: int
+    gas_used: int
+    events: Dict[str, List[str]] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "height": self.height, "index": self.index, "tx": self.tx.hex(),
+            "code": self.code, "data": self.data.hex(), "log": self.log,
+            "gas_wanted": self.gas_wanted, "gas_used": self.gas_used,
+            "events": self.events,
+        }).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "TxResult":
+        d = json.loads(raw)
+        return TxResult(d["height"], d["index"], bytes.fromhex(d["tx"]),
+                        d["code"], bytes.fromhex(d["data"]), d["log"],
+                        d["gas_wanted"], d["gas_used"], d.get("events", {}))
+
+
+class KVTxIndexer:
+    """(state/txindex/kv/kv.go TxIndex)"""
+
+    def __init__(self, db: DB):
+        self.db = db
+
+    def index(self, result: TxResult) -> None:
+        tx_hash = hashlib.sha256(result.tx).digest()
+        self.db.set(_TX_HASH_PREFIX + tx_hash, result.to_json())
+        for key, values in result.events.items():
+            for v in values:
+                self.db.set(self._event_key(key, v, result.height, result.index),
+                            tx_hash)
+        # implicit tx.height index (kv.go indexes it always)
+        self.db.set(self._event_key(tme.TX_HEIGHT_KEY, str(result.height),
+                                    result.height, result.index), tx_hash)
+
+    def get(self, tx_hash: bytes) -> Optional[TxResult]:
+        raw = self.db.get(_TX_HASH_PREFIX + tx_hash)
+        return TxResult.from_json(raw) if raw else None
+
+    def search(self, query: str, limit: int = 100) -> List[TxResult]:
+        """(kv.go Search) intersect per-condition hash sets; '=' only fast
+        path, plus range ops evaluated against the stored event values."""
+        q = Query(query)
+        result_sets: List[set] = []
+        for cond in q.conditions:
+            matches = set()
+            prefix = _TX_EVENT_PREFIX + cond.key.encode() + b"/"
+            for k, v in self.db.iterate(prefix, prefix + b"\xff"):
+                parts = k[len(prefix):].rsplit(b"/", 2)
+                if len(parts) != 3:
+                    continue
+                value = parts[0].decode()
+                if _cond_matches(cond, value):
+                    matches.add(v)
+            result_sets.append(matches)
+        if not result_sets:
+            return []
+        hashes = set.intersection(*result_sets)
+        out = [self.get(h) for h in hashes]
+        out = [r for r in out if r is not None]
+        out.sort(key=lambda r: (r.height, r.index))
+        return out[:limit]
+
+    @staticmethod
+    def _event_key(key: str, value: str, height: int, index: int) -> bytes:
+        return (_TX_EVENT_PREFIX + key.encode() + b"/" + value.encode()
+                + b"/" + str(height).encode() + b"/" + str(index).encode())
+
+
+class KVBlockIndexer:
+    """(state/indexer/block/kv) indexes begin/end-block events by height."""
+
+    def __init__(self, db: DB):
+        self.db = db
+
+    def index(self, height: int, events: Dict[str, List[str]]) -> None:
+        self.db.set(_BLOCK_EVENT_PREFIX + b"height/%d" % height,
+                    str(height).encode())
+        for key, values in events.items():
+            for v in values:
+                self.db.set(
+                    _BLOCK_EVENT_PREFIX + key.encode() + b"/" + v.encode()
+                    + b"/%d" % height, str(height).encode())
+
+    def search(self, query: str, limit: int = 100) -> List[int]:
+        q = Query(query)
+        result_sets: List[set] = []
+        for cond in q.conditions:
+            matches = set()
+            prefix = _BLOCK_EVENT_PREFIX + cond.key.encode() + b"/"
+            for k, v in self.db.iterate(prefix, prefix + b"\xff"):
+                value = k[len(prefix):].rsplit(b"/", 1)[0].decode()
+                if _cond_matches(cond, value):
+                    matches.add(int(v))
+            result_sets.append(matches)
+        if not result_sets:
+            return []
+        heights = sorted(set.intersection(*result_sets))
+        return heights[:limit]
+
+
+def _cond_matches(cond, value: str) -> bool:
+    if cond.op == "EXISTS":
+        return True
+    if cond.op == "=":
+        if isinstance(cond.value, (int, float)):
+            try:
+                return float(value) == float(cond.value)
+            except ValueError:
+                return False
+        return value == str(cond.value).strip("'")
+    if cond.op == "CONTAINS":
+        return str(cond.value).strip("'") in value
+    try:
+        lhs = float(value)
+        rhs = float(cond.value)
+    except (TypeError, ValueError):
+        return False
+    return {"<": lhs < rhs, "<=": lhs <= rhs,
+            ">": lhs > rhs, ">=": lhs >= rhs}[cond.op]
+
+
+class IndexerService:
+    """(state/txindex/indexer_service.go) EventBus → indexers pump."""
+
+    def __init__(self, tx_indexer: KVTxIndexer, block_indexer: KVBlockIndexer,
+                 event_bus):
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self._tasks: List[asyncio.Task] = []
+
+    async def start(self) -> None:
+        tx_sub = self.event_bus.subscribe("indexer-tx", tme.QUERY_TX,
+                                          out_capacity=1000)
+        blk_sub = self.event_bus.subscribe("indexer-blk",
+                                           tme.QUERY_NEW_BLOCK_HEADER,
+                                           out_capacity=1000)
+        self._tasks = [asyncio.create_task(self._pump_tx(tx_sub)),
+                       asyncio.create_task(self._pump_block(blk_sub))]
+
+    async def stop(self) -> None:
+        self.event_bus.unsubscribe_all("indexer-tx")
+        self.event_bus.unsubscribe_all("indexer-blk")
+        for t in self._tasks:
+            t.cancel()
+
+    async def _pump_tx(self, sub) -> None:
+        from ..libs.pubsub import SubscriptionCanceled
+
+        try:
+            while True:
+                msg = await sub.next()
+                ev = msg.data
+                r = ev.result
+                self.tx_indexer.index(TxResult(
+                    height=ev.height, index=ev.index, tx=ev.tx,
+                    code=getattr(r, "code", 0), data=getattr(r, "data", b""),
+                    log=getattr(r, "log", ""),
+                    gas_wanted=getattr(r, "gas_wanted", 0),
+                    gas_used=getattr(r, "gas_used", 0),
+                    events=msg.events))
+        except (SubscriptionCanceled, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("tx indexer pump died")
+
+    async def _pump_block(self, sub) -> None:
+        from ..libs.pubsub import SubscriptionCanceled
+
+        try:
+            while True:
+                msg = await sub.next()
+                header = getattr(msg.data, "header", None)
+                height = header.height if header else 0
+                self.block_indexer.index(height, msg.events)
+        except (SubscriptionCanceled, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("block indexer pump died")

@@ -1,0 +1,7 @@
+"""State sync (reference statesync/): bootstrap a fresh node from an
+application snapshot served by peers, verified against a light-client-
+obtained header, then hand off to fast sync / consensus."""
+
+from .reactor import StateSyncReactor  # noqa: F401
+from .syncer import Syncer, SyncError  # noqa: F401
+from .stateprovider import LightClientStateProvider, StateProvider  # noqa: F401

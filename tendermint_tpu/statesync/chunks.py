@@ -1,0 +1,68 @@
+"""Chunk queue for an in-flight snapshot restore
+(reference statesync/chunks.go): dedup, per-chunk sender tracking,
+allocation of next-to-fetch indexes, and refetch support."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Set
+
+
+class ChunkQueue:
+    def __init__(self, n_chunks: int):
+        self.n_chunks = n_chunks
+        self._chunks: Dict[int, bytes] = {}
+        self._senders: Dict[int, str] = {}
+        self._allocated: Set[int] = set()
+        self._returned: Set[int] = set()
+        self._event = asyncio.Event()
+
+    def allocate(self) -> Optional[int]:
+        """Next chunk index to fetch, or None when all are assigned."""
+        for i in range(self.n_chunks):
+            if i not in self._allocated and i not in self._chunks:
+                self._allocated.add(i)
+                return i
+        return None
+
+    def add(self, index: int, chunk: bytes, sender: str) -> bool:
+        if not 0 <= index < self.n_chunks or index in self._chunks:
+            return False
+        self._chunks[index] = chunk
+        self._senders[index] = sender
+        self._event.set()
+        return True
+
+    def sender(self, index: int) -> str:
+        return self._senders.get(index, "")
+
+    def discard(self, index: int) -> None:
+        """(chunks.go Discard) drop a chunk so it is refetched."""
+        self._chunks.pop(index, None)
+        self._senders.pop(index, None)
+        self._allocated.discard(index)
+
+    def discard_sender(self, sender: str) -> None:
+        for i, s in list(self._senders.items()):
+            if s == sender:
+                self.discard(i)
+
+    def retry_all(self) -> None:
+        for i in list(self._chunks):
+            self.discard(i)
+
+    def has(self, index: int) -> bool:
+        return index in self._chunks
+
+    def get(self, index: int) -> Optional[bytes]:
+        return self._chunks.get(index)
+
+    def complete(self) -> bool:
+        return len(self._chunks) == self.n_chunks
+
+    async def wait_change(self, timeout: float) -> None:
+        try:
+            await asyncio.wait_for(self._event.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        self._event.clear()

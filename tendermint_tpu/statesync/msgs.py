@@ -1,0 +1,90 @@
+"""State-sync wire messages (reference proto/tendermint/statesync/types.proto,
+statesync/messages.go): oneof {snapshots_request=1, snapshots_response=2,
+chunk_request=3, chunk_response=4}."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..libs import protowire as pw
+
+
+@dataclass
+class SnapshotsRequest:
+    pass
+
+
+@dataclass
+class SnapshotsResponse:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+
+@dataclass
+class ChunkRequest:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+
+
+@dataclass
+class ChunkResponse:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+    chunk: bytes = b""
+    missing: bool = False
+
+
+def encode_msg(msg) -> bytes:
+    w = pw.Writer()
+    if isinstance(msg, SnapshotsRequest):
+        w.message(1, b"")
+    elif isinstance(msg, SnapshotsResponse):
+        inner = pw.Writer()
+        inner.varint(1, msg.height)
+        inner.varint(2, msg.format)
+        inner.varint(3, msg.chunks)
+        inner.bytes(4, msg.hash)
+        inner.bytes(5, msg.metadata)
+        w.message(2, inner.finish())
+    elif isinstance(msg, ChunkRequest):
+        inner = pw.Writer()
+        inner.varint(1, msg.height)
+        inner.varint(2, msg.format)
+        inner.varint(3, msg.index)
+        w.message(3, inner.finish())
+    elif isinstance(msg, ChunkResponse):
+        inner = pw.Writer()
+        inner.varint(1, msg.height)
+        inner.varint(2, msg.format)
+        inner.varint(3, msg.index)
+        inner.bytes(4, msg.chunk)
+        if msg.missing:
+            inner.bool(5, True)
+        w.message(4, inner.finish())
+    else:
+        raise TypeError(f"unknown statesync msg {type(msg)}")
+    return w.finish()
+
+
+def decode_msg(data: bytes):
+    for fn, _wt, v in pw.iter_fields(data):
+        f = pw.fields_dict(v)
+        if fn == 1:
+            return SnapshotsRequest()
+        if fn == 2:
+            return SnapshotsResponse(f.get(1, [0])[0], f.get(2, [0])[0],
+                                     f.get(3, [0])[0], f.get(4, [b""])[0],
+                                     f.get(5, [b""])[0])
+        if fn == 3:
+            return ChunkRequest(f.get(1, [0])[0], f.get(2, [0])[0],
+                                f.get(3, [0])[0])
+        if fn == 4:
+            return ChunkResponse(f.get(1, [0])[0], f.get(2, [0])[0],
+                                 f.get(3, [0])[0], f.get(4, [b""])[0],
+                                 bool(f.get(5, [0])[0]))
+    raise ValueError("empty statesync message")

@@ -1,0 +1,107 @@
+"""State-sync reactor — channels Snapshot=0x60, Chunk=0x61
+(reference statesync/reactor.go:22,31): serves local app snapshots to
+syncing peers and feeds inbound snapshots/chunks to the Syncer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional
+
+from ..abci import types as abci
+from ..p2p import CHUNK_CHANNEL, SNAPSHOT_CHANNEL
+from ..p2p.base import ChannelDescriptor, Peer, Reactor
+from .msgs import (
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+    decode_msg,
+    encode_msg,
+)
+from .syncer import Syncer
+
+logger = logging.getLogger("tmtpu.statesync")
+
+# advertise at most this many snapshots per request (reactor.go)
+RECENT_SNAPSHOTS = 10
+
+
+class StateSyncReactor(Reactor):
+    def __init__(self, proxy_snapshot, proxy_query):
+        super().__init__("STATESYNC")
+        self.app_snapshot = proxy_snapshot
+        self.app_query = proxy_query
+        self.syncer: Optional[Syncer] = None
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              send_queue_capacity=10,
+                              recv_message_capacity=4 << 20),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              send_queue_capacity=4,
+                              recv_message_capacity=16 << 20),
+        ]
+
+    async def add_peer(self, peer: Peer) -> None:
+        # ask new peers for their snapshots while we are syncing
+        if self.syncer is not None:
+            peer.try_send(SNAPSHOT_CHANNEL, encode_msg(SnapshotsRequest()))
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        if self.syncer is not None:
+            self.syncer.pool.remove_peer(peer.id)
+
+    async def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = decode_msg(msg_bytes)
+        if isinstance(msg, SnapshotsRequest):
+            for s in self._local_snapshots():
+                peer.try_send(SNAPSHOT_CHANNEL, encode_msg(
+                    SnapshotsResponse(s.height, s.format, s.chunks, s.hash,
+                                      s.metadata)))
+        elif isinstance(msg, SnapshotsResponse):
+            if self.syncer is not None:
+                if self.syncer.add_snapshot(peer.id, msg):
+                    logger.info("discovered snapshot h=%d fmt=%d from %s",
+                                msg.height, msg.format, peer.id[:8])
+        elif isinstance(msg, ChunkRequest):
+            resp = self.app_snapshot.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index))
+            missing = not resp.chunk
+            peer.try_send(CHUNK_CHANNEL, encode_msg(ChunkResponse(
+                msg.height, msg.format, msg.index, resp.chunk, missing)))
+        elif isinstance(msg, ChunkResponse):
+            if self.syncer is not None:
+                self.syncer.add_chunk(msg, peer.id)
+
+    def _local_snapshots(self):
+        try:
+            resp = self.app_snapshot.list_snapshots(abci.RequestListSnapshots())
+        except Exception:
+            return []
+        snaps = sorted(resp.snapshots, key=lambda s: (s.height, s.format),
+                       reverse=True)
+        return snaps[:RECENT_SNAPSHOTS]
+
+    # -- sync orchestration (reactor.go Sync / node.go:648 startStateSync) ---
+
+    async def sync(self, state_provider, discovery_time: float = 5.0):
+        """Run a snapshot restore; -> (state, commit). The caller bootstraps
+        the stores and hands off to fast sync / consensus."""
+        async def request_chunk(peer_id, height, fmt, idx):
+            peer = self.switch.peers.get(peer_id) if self.switch else None
+            if peer is None:
+                raise RuntimeError(f"peer {peer_id[:8]} gone")
+            peer.try_send(CHUNK_CHANNEL, encode_msg(
+                ChunkRequest(height, fmt, idx)))
+
+        self.syncer = Syncer(self.app_snapshot, self.app_query, state_provider,
+                             request_chunk)
+        if self.switch is not None:
+            self.switch.broadcast(SNAPSHOT_CHANNEL, encode_msg(SnapshotsRequest()))
+        try:
+            return await self.syncer.sync_any(discovery_time)
+        finally:
+            self.syncer = None

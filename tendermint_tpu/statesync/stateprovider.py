@@ -1,0 +1,77 @@
+"""State providers: build a trusted sm.State + Commit at the snapshot height
+(reference statesync/stateprovider.go:39 — backed by the light client over
+2+ RPC servers).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..light.client import LightClient, TrustOptions
+from ..light.provider import HTTPProvider
+from ..state.state import State
+from ..types.block import Commit
+from ..types.params import ConsensusParams
+
+
+class StateProvider:
+    async def app_hash(self, height: int) -> bytes:
+        raise NotImplementedError
+
+    async def commit(self, height: int) -> Commit:
+        raise NotImplementedError
+
+    async def state(self, height: int) -> State:
+        raise NotImplementedError
+
+
+class LightClientStateProvider(StateProvider):
+    """(stateprovider.go lightClientStateProvider)
+
+    Verifies headers via the light client (bisection from the trust root)
+    and assembles the post-snapshot State the node boots consensus from.
+    """
+
+    def __init__(self, chain_id: str, genesis, rpc_clients: List,
+                 trust_options: TrustOptions):
+        if len(rpc_clients) < 2:
+            raise ValueError("state sync needs >= 2 rpc servers "
+                             "(primary + witness)")
+        self.chain_id = chain_id
+        self.genesis = genesis
+        providers = [HTTPProvider(chain_id, c) for c in rpc_clients]
+        self.client = LightClient(chain_id, trust_options, providers[0],
+                                  providers[1:])
+
+    async def app_hash(self, height: int) -> bytes:
+        """AppHash for `height` lives in header `height+1` (stateprovider.go)."""
+        lb = await self.client.verify_light_block_at_height(height + 1)
+        return lb.signed_header.header.app_hash
+
+    async def commit(self, height: int) -> Commit:
+        lb = await self.client.verify_light_block_at_height(height)
+        return lb.signed_header.commit
+
+    async def state(self, height: int) -> State:
+        """(stateprovider.go State) needs headers h, h+1, h+2:
+        h+1 carries AppHash + LastResultsHash, h+2's validators are
+        NextValidators of h+1."""
+        last = await self.client.verify_light_block_at_height(height)
+        cur = await self.client.verify_light_block_at_height(height + 1)
+        nxt = await self.client.verify_light_block_at_height(height + 2)
+        state = State(
+            last_validators=last.validator_set,
+            chain_id=self.chain_id,
+            initial_height=self.genesis.initial_height or 1,
+            last_block_height=cur.signed_header.header.height - 1,
+            last_block_id=cur.signed_header.header.last_block_id,
+            last_block_time_ns=last.signed_header.header.time_ns,
+            validators=cur.validator_set,
+            next_validators=nxt.validator_set,
+            last_height_validators_changed=cur.signed_header.header.height,
+            consensus_params=self.genesis.consensus_params or ConsensusParams(),
+            last_height_consensus_params_changed=self.genesis.initial_height or 1,
+            app_hash=cur.signed_header.header.app_hash,
+            last_results_hash=cur.signed_header.header.last_results_hash,
+        )
+        return state

@@ -1,0 +1,245 @@
+"""Snapshot restore orchestration (reference statesync/syncer.go:145
+SyncAny): pick a snapshot advertised by peers, OfferSnapshot to the app,
+fetch chunks with parallel fetchers, ApplySnapshotChunk with
+retry/refetch/reject semantics, and verify the restored app hash against a
+light-client-obtained header.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..abci import types as abci
+from .chunks import ChunkQueue
+from .stateprovider import StateProvider
+
+logger = logging.getLogger("tmtpu.statesync")
+
+CHUNK_FETCHERS = 4
+CHUNK_REQUEST_TIMEOUT = 10.0
+
+
+class SyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(SyncError):
+    pass
+
+
+class ErrSnapshotRejected(SyncError):
+    pass
+
+
+class ErrRetrySnapshot(SyncError):
+    pass
+
+
+class ErrAbort(SyncError):
+    pass
+
+
+@dataclass(frozen=True)
+class SnapshotKey:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+
+
+@dataclass
+class SnapshotPool:
+    """Snapshots advertised by peers, best (highest, then format) first."""
+
+    snapshots: Dict[SnapshotKey, Set[str]] = field(default_factory=dict)
+    rejected: Set[SnapshotKey] = field(default_factory=set)
+    metadata: Dict[SnapshotKey, bytes] = field(default_factory=dict)
+
+    def add(self, peer_id: str, height: int, fmt: int, chunks: int,
+            hash_: bytes, meta: bytes) -> bool:
+        key = SnapshotKey(height, fmt, chunks, hash_)
+        if key in self.rejected:
+            return False
+        new = key not in self.snapshots
+        self.snapshots.setdefault(key, set()).add(peer_id)
+        self.metadata[key] = meta
+        return new
+
+    def best(self) -> Optional[SnapshotKey]:
+        cands = [k for k in self.snapshots if k not in self.rejected]
+        if not cands:
+            return None
+        return max(cands, key=lambda k: (k.height, k.format))
+
+    def reject(self, key: SnapshotKey) -> None:
+        self.rejected.add(key)
+        self.snapshots.pop(key, None)
+
+    def reject_format(self, fmt: int) -> None:
+        for k in list(self.snapshots):
+            if k.format == fmt:
+                self.reject(k)
+
+    def remove_peer(self, peer_id: str) -> None:
+        for k, peers in list(self.snapshots.items()):
+            peers.discard(peer_id)
+            if not peers:
+                del self.snapshots[k]
+
+    def peers_of(self, key: SnapshotKey) -> List[str]:
+        return list(self.snapshots.get(key, ()))
+
+
+class Syncer:
+    """(syncer.go) Drives one snapshot restore against the app."""
+
+    def __init__(self, proxy_snapshot, proxy_query, state_provider: StateProvider,
+                 request_chunk, chunk_fetchers: int = CHUNK_FETCHERS,
+                 chunk_timeout: float = CHUNK_REQUEST_TIMEOUT):
+        self.app_snapshot = proxy_snapshot
+        self.app_query = proxy_query
+        self.state_provider = state_provider
+        self.request_chunk = request_chunk  # async (peer_id, height, fmt, idx)
+        self.pool = SnapshotPool()
+        self.chunk_fetchers = chunk_fetchers
+        self.chunk_timeout = chunk_timeout
+        self.chunks: Optional[ChunkQueue] = None
+        self._current: Optional[SnapshotKey] = None
+
+    def add_snapshot(self, peer_id: str, resp) -> bool:
+        return self.pool.add(peer_id, resp.height, resp.format, resp.chunks,
+                             resp.hash, resp.metadata)
+
+    def add_chunk(self, resp, sender: str) -> None:
+        cur = self._current
+        if (self.chunks is None or cur is None
+                or resp.height != cur.height or resp.format != cur.format):
+            return
+        if resp.missing:
+            self.chunks.discard(resp.index)
+            return
+        self.chunks.add(resp.index, resp.chunk, sender)
+
+    async def sync_any(self, discovery_time: float = 5.0):
+        """(syncer.go:145 SyncAny) -> (state, commit) for the restored height.
+        Tries snapshots best-first until one restores or none remain."""
+        await asyncio.sleep(discovery_time)
+        while True:
+            key = self.pool.best()
+            if key is None:
+                raise ErrNoSnapshots("no viable snapshots remain")
+            try:
+                return await self._sync(key)
+            except ErrSnapshotRejected:
+                logger.info("snapshot %d/%d rejected; trying next",
+                            key.height, key.format)
+                self.pool.reject(key)
+            except ErrRetrySnapshot:
+                logger.info("retrying snapshot %d/%d", key.height, key.format)
+            except ErrAbort:
+                raise
+
+    async def _sync(self, key: SnapshotKey):
+        """(syncer.go Sync) one snapshot attempt."""
+        self._current = key
+        self.chunks = ChunkQueue(key.chunks)
+
+        # fetch trusted app hash FIRST (stateprovider → light client): the
+        # offer to the app carries it
+        app_hash = await self.state_provider.app_hash(key.height)
+
+        resp = self.app_snapshot.offer_snapshot(abci.RequestOfferSnapshot(
+            snapshot=abci.Snapshot(key.height, key.format, key.chunks,
+                                   key.hash, self.pool.metadata.get(key, b"")),
+            app_hash=app_hash))
+        if resp.result == abci.OFFER_SNAPSHOT_REJECT:
+            raise ErrSnapshotRejected("offer rejected")
+        if resp.result == abci.OFFER_SNAPSHOT_REJECT_FORMAT:
+            self.pool.reject_format(key.format)
+            raise ErrSnapshotRejected("format rejected")
+        if resp.result == abci.OFFER_SNAPSHOT_ABORT:
+            raise ErrAbort("app aborted snapshot restore")
+        if resp.result != abci.OFFER_SNAPSHOT_ACCEPT:
+            raise ErrSnapshotRejected(f"unknown offer result {resp.result}")
+
+        # parallel fetchers (syncer.go:415)
+        fetchers = [asyncio.create_task(self._fetch_loop(key))
+                    for _ in range(self.chunk_fetchers)]
+        try:
+            applied = 0
+            while applied < key.chunks:
+                if not self.chunks.has(applied):
+                    await self.chunks.wait_change(0.25)
+                    continue
+                chunk = self.chunks.get(applied)
+                r = self.app_snapshot.apply_snapshot_chunk(
+                    abci.RequestApplySnapshotChunk(
+                        index=applied, chunk=chunk,
+                        sender=self.chunks.sender(applied)))
+                if r.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT:
+                    applied += 1
+                elif r.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY:
+                    self.chunks.discard(applied)
+                elif r.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY_SNAPSHOT:
+                    raise ErrRetrySnapshot("app requested snapshot retry")
+                elif r.result == abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT:
+                    raise ErrSnapshotRejected("app rejected snapshot")
+                elif r.result == abci.APPLY_SNAPSHOT_CHUNK_ABORT:
+                    raise ErrAbort("app aborted during chunk apply")
+                for idx in r.refetch_chunks:
+                    self.chunks.discard(idx)
+                for sender in r.reject_senders:
+                    self.chunks.discard_sender(sender)
+                    self.pool.remove_peer(sender)
+        finally:
+            for f in fetchers:
+                f.cancel()
+
+        # verify the restored app against the trusted header (syncer.go:485)
+        info = self.app_query.info(abci.RequestInfo())
+        if info.last_block_app_hash != app_hash:
+            raise ErrSnapshotRejected(
+                f"restored app hash {info.last_block_app_hash.hex()} != trusted "
+                f"{app_hash.hex()}")
+        if info.last_block_height != key.height:
+            raise ErrSnapshotRejected(
+                f"restored app height {info.last_block_height} != {key.height}")
+
+        state = await self.state_provider.state(key.height)
+        commit = await self.state_provider.commit(key.height)
+        logger.info("snapshot restored at height %d", key.height)
+        return state, commit
+
+    async def _fetch_loop(self, key: SnapshotKey) -> None:
+        """One fetcher: allocate an index, ask a random peer, await arrival
+        or re-allocate on timeout."""
+        import random
+
+        while True:
+            idx = self.chunks.allocate()
+            if idx is None:
+                # never exit while the restore runs: a RETRY/refetch/reject
+                # can discard chunks after completeness and needs a live
+                # fetcher; cancellation (finally block in _sync) ends us
+                await asyncio.sleep(0.1)
+                continue
+            peers = self.pool.peers_of(key)
+            if not peers:
+                await asyncio.sleep(0.5)
+                self.chunks.discard(idx)
+                continue
+            peer_id = random.choice(peers)
+            try:
+                await self.request_chunk(peer_id, key.height, key.format, idx)
+            except Exception:
+                self.chunks.discard(idx)
+                continue
+            deadline = asyncio.get_running_loop().time() + self.chunk_timeout
+            while not self.chunks.has(idx):
+                if asyncio.get_running_loop().time() > deadline:
+                    self.chunks.discard(idx)  # re-allocate elsewhere
+                    break
+                await self.chunks.wait_change(0.25)

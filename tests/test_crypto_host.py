@@ -142,3 +142,40 @@ def test_merkle_proofs(n):
         if n > 1:
             assert not pr.verify(root, items[(i + 1) % n])
         assert not pr.verify(os.urandom(32), items[i])
+
+
+def test_secp256k1_sign_verify_address():
+    """secp256k1 key type (reference crypto/secp256k1): 33B compressed pub,
+    RIPEMD160(SHA256(pub)) address, 64B low-S signatures."""
+    from tendermint_tpu.crypto.secp256k1 import (
+        Secp256k1PrivKey,
+        Secp256k1PubKey,
+        _N,
+    )
+
+    priv = Secp256k1PrivKey.generate(b"determinism")
+    pub = priv.pub_key()
+    assert len(pub.bytes()) == 33 and pub.bytes()[0] in (2, 3)
+    assert len(pub.address()) == 20
+
+    sig = priv.sign(b"hello")
+    assert len(sig) == 64
+    assert pub.verify_signature(b"hello", sig)
+    assert not pub.verify_signature(b"hello!", sig)
+    assert not pub.verify_signature(b"hello", sig[:-1] + b"\x00")
+
+    # high-S malleated twin must be rejected (btcec convention)
+    import hashlib
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    high_s = _N - s
+    mall = r.to_bytes(32, "big") + high_s.to_bytes(32, "big")
+    assert not pub.verify_signature(b"hello", mall)
+
+    # round-trip through bytes
+    pub2 = Secp256k1PubKey(pub.bytes())
+    assert pub2.verify_signature(b"hello", sig)
+    assert pub2.address() == pub.address()
+
+    # deterministic generate from seed
+    assert Secp256k1PrivKey.generate(b"determinism").bytes() == priv.bytes()

@@ -1,0 +1,81 @@
+"""v1 priority mempool semantics (reference mempool/v1/mempool.go) and the
+counter example app (reference abci/example/counter).
+"""
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.abci.example.counter import CounterApplication
+from tendermint_tpu.mempool.priority_mempool import PriorityMempool
+from tendermint_tpu.proxy import AppConns, local_client_creator
+
+
+class PrioApp(Application):
+    """Assigns priority = first byte of the tx."""
+
+    def check_tx(self, req):
+        if req.tx == b"":
+            return abci.ResponseCheckTx(code=1, log="empty")
+        return abci.ResponseCheckTx(code=0, priority=req.tx[0], gas_wanted=1)
+
+
+def _mk(maxtxs=3):
+    conns = AppConns(local_client_creator(PrioApp()))
+    conns.start()
+    return PriorityMempool(conns.mempool, max_txs=maxtxs)
+
+
+def test_priority_ordering_and_reap():
+    mp = _mk(maxtxs=10)
+    for tx in (b"\x05low", b"\x50mid", b"\xa0high"):
+        assert mp.check_tx(tx).code == 0
+    assert mp.reap_max_txs(10) == [b"\xa0high", b"\x50mid", b"\x05low"]
+    # byte/gas caps respected
+    assert mp.reap_max_bytes_max_gas(5, -1) == [b"\xa0high"]
+    assert len(mp.reap_max_bytes_max_gas(-1, 2)) == 2
+
+
+def test_eviction_of_lower_priority_when_full():
+    mp = _mk(maxtxs=3)
+    for tx in (b"\x10a", b"\x20b", b"\x30c"):
+        assert mp.check_tx(tx).code == 0
+    # lower-priority incoming is rejected outright
+    assert mp.check_tx(b"\x01z").code != 0
+    assert mp.size() == 3
+    # higher-priority incoming evicts the lowest resident
+    assert mp.check_tx(b"\x99hi").code == 0
+    assert mp.size() == 3
+    txs = mp.reap_max_txs(10)
+    assert b"\x99hi" in txs and b"\x10a" not in txs
+
+
+def test_update_removes_committed_and_rechecks():
+    mp = _mk(maxtxs=10)
+    mp.check_tx(b"\x10a")
+    mp.check_tx(b"\x20b")
+    mp.update(2, [b"\x10a"])
+    assert mp.reap_max_txs(10) == [b"\x20b"]
+    # committed tx stays cached: re-adding is a no-op
+    assert mp.check_tx(b"\x10a").log == "tx already in cache"
+    assert mp.size() == 1
+
+
+def test_counter_app_serial_semantics():
+    app = CounterApplication(serial=True)
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    c = conns.consensus
+    # correct nonce order accepted
+    for i in range(3):
+        assert c.deliver_tx(abci.RequestDeliverTx(
+            tx=i.to_bytes(8, "big"))).code == 0
+    # replay and skip rejected
+    assert c.deliver_tx(abci.RequestDeliverTx(
+        tx=(1).to_bytes(8, "big"))).code == 2
+    assert c.deliver_tx(abci.RequestDeliverTx(
+        tx=(9).to_bytes(8, "big"))).code == 2
+    # CheckTx rejects stale nonces
+    assert conns.mempool.check_tx(abci.RequestCheckTx(
+        tx=(0).to_bytes(8, "big"))).code == 2
+    assert conns.mempool.check_tx(abci.RequestCheckTx(
+        tx=(5).to_bytes(8, "big"))).code == 0
+    assert c.commit().data == (3).to_bytes(8, "big")

@@ -1,0 +1,64 @@
+"""Remote signer: the node-side SignerClient over a listener endpoint, the
+key-side SignerServer dialing in, double-sign protection enforced remotely
+(reference privval/signer_client.go, signer_listener_endpoint.go).
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV
+from tendermint_tpu.privval.signer import (
+    RemoteSignerError,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "signer-chain"
+
+
+def test_remote_signer_round_trip():
+    pv = FilePV.generate("", "")
+    endpoint = SignerListenerEndpoint("127.0.0.1", 0)
+    server = SignerServer(pv, CHAIN, ("127.0.0.1", endpoint.port))
+    server.start()
+    try:
+        endpoint.wait_for_signer(timeout=10.0)
+        client = SignerClient(endpoint, CHAIN)
+
+        # pubkey round-trips
+        assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+        assert client.ping()
+
+        # vote signing matches local signing semantics
+        bid = BlockID(b"\x0a" * 32, PartSetHeader(1, b"\x0b" * 32))
+        vote = Vote(SignedMsgType.PREVOTE, 5, 0, bid,
+                    1_700_000_000_000_000_000,
+                    pv.get_pub_key().address(), 0, b"")
+        client.sign_vote(CHAIN, vote)
+        assert vote.signature
+        assert pv.get_pub_key().verify_signature(
+            vote.sign_bytes(CHAIN), vote.signature)
+
+        # proposal signing
+        prop = Proposal(6, 0, -1, bid, 1_700_000_000_000_000_001)
+        client.sign_proposal(CHAIN, prop)
+        assert pv.get_pub_key().verify_signature(
+            prop.sign_bytes(CHAIN), prop.signature)
+
+        # double-sign protection holds ACROSS the socket: conflicting vote
+        # at the same HRS is refused by the remote FilePV
+        conflicting = Vote(SignedMsgType.PREVOTE, 5, 0,
+                           BlockID(b"\xff" * 32, PartSetHeader(1, b"\x0b" * 32)),
+                           1_700_000_000_000_000_002,
+                           pv.get_pub_key().address(), 0, b"")
+        with pytest.raises(RemoteSignerError):
+            client.sign_vote(CHAIN, conflicting)
+    finally:
+        server.stop()
+        endpoint.close()

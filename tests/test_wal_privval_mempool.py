@@ -30,8 +30,11 @@ class TestWAL:
         wal.write_end_height(1, 125)
         wal.close()
         msgs = list(WAL(str(tmp_path / "w.wal")).iter_messages())
-        assert [m.type for m in msgs] == ["round_step", "timeout", "end_height"]
-        assert msgs[1].data["duration_s"] == 1.5
+        # a fresh WAL self-writes #ENDHEIGHT 0 (wal.go BaseWAL.OnStart)
+        assert [m.type for m in msgs] == ["end_height", "round_step",
+                                          "timeout", "end_height"]
+        assert msgs[0].data["height"] == 0
+        assert msgs[2].data["duration_s"] == 1.5
 
     def test_torn_tail_tolerated(self, tmp_path):
         path = str(tmp_path / "w.wal")
@@ -42,7 +45,7 @@ class TestWAL:
         with open(path, "ab") as f:
             f.write(b"\x00\x01\x02")  # torn write
         msgs = list(WAL(path).iter_messages())
-        assert len(msgs) == 2
+        assert len(msgs) == 3  # incl. the auto #ENDHEIGHT 0
 
     def test_corrupt_crc_stops_replay(self, tmp_path):
         path = str(tmp_path / "w.wal")
@@ -54,7 +57,7 @@ class TestWAL:
         raw[-1] ^= 0xFF  # corrupt last record's payload
         open(path, "wb").write(bytes(raw))
         msgs = list(WAL(path).iter_messages())
-        assert len(msgs) == 1
+        assert len(msgs) == 2  # ENDHEIGHT 0 + first record; corrupt tail dropped
 
     def test_search_for_end_height(self, tmp_path):
         wal = WAL(str(tmp_path / "w.wal"))
@@ -72,7 +75,7 @@ class TestWAL:
             wal.write("round_step", {"height": i, "pad": "x" * 50}, i)
         wal.close()
         assert os.path.exists(path + ".0")  # rotated
-        msgs = list(WAL(path).iter_messages())
+        msgs = [m for m in WAL(path).iter_messages() if m.type == "round_step"]
         assert len(msgs) == 100  # reads across rotated files
         assert [m.data["height"] for m in msgs] == list(range(100))
 
