@@ -20,7 +20,9 @@ Timing includes host-side packing (prepare_batch) — the device path is
 charged end-to-end, same as the baseline loop.
 """
 
+import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -89,5 +91,172 @@ def main():
     }))
 
 
+# --- BASELINE configs #2/#3/#5 (VerifyCommit paths) -------------------------
+
+def _mk_val_set(n_vals: int, seed: int = 7):
+    """A validator set + its signing keys (OpenSSL), reusable across heights."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    from tendermint_tpu import crypto
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    rng = np.random.default_rng(seed)
+    keys = {}
+    vals = []
+    for _ in range(n_vals):
+        sk = Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+        pub = crypto.Ed25519PubKey(sk.public_key().public_bytes_raw())
+        keys[pub.address()] = sk
+        vals.append(Validator(pub.address(), pub, 10))
+    return ValidatorSet(vals), keys
+
+
+def _sign_commit(vs, keys, height: int, chain_id: str):
+    """A canonical commit for `height` signed by every validator, in
+    validator-set order."""
+    from tendermint_tpu.types.basic import (
+        BlockID,
+        BlockIDFlag,
+        PartSetHeader,
+        SignedMsgType,
+    )
+    from tendermint_tpu.types.block import Commit, CommitSig
+    from tendermint_tpu.types.canonical import vote_sign_bytes
+
+    bid = BlockID(hash(("bench", height)).to_bytes(8, "big", signed=True) * 4,
+                  PartSetHeader(1, b"\x02" * 32))
+    sigs = []
+    for i, v in enumerate(vs.validators):
+        ts = 1_700_000_000_000_000_000 + height * 1_000_000 + i
+        msg = vote_sign_bytes(chain_id, SignedMsgType.PRECOMMIT, height, 0,
+                              bid, ts)
+        sigs.append(CommitSig(BlockIDFlag.COMMIT, v.address, ts,
+                              keys[v.address].sign(msg)))
+    return Commit(height, 0, bid, sigs), bid
+
+
+def _timed(fn, warm: int = 1, runs: int = 3) -> float:
+    for _ in range(warm):
+        fn()
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_verify_commit_150():
+    """Config #2: ValidatorSet.VerifyCommit over a 150-validator commit
+    (reference types/validator_set.go:667)."""
+    vs, keys = _mk_val_set(150)
+    commit, bid = _sign_commit(vs, keys, 100, "bench-150")
+    dev = _timed(lambda: vs.verify_commit("bench-150", bid, 100, commit))
+    os.environ["TMTPU_BATCH_BACKEND"] = "host"
+    try:
+        host = _timed(lambda: vs.verify_commit("bench-150", bid, 100, commit))
+    finally:
+        del os.environ["TMTPU_BATCH_BACKEND"]
+    print(json.dumps({
+        "metric": "verify_commit_150_vals_sigs_per_sec",
+        "value": round(150 / dev, 1), "unit": "sigs/s",
+        "vs_baseline": round(host / dev, 3),
+    }))
+
+
+def bench_light_chain_1000():
+    """Config #3: light-client VerifyCommitLight+Trusting over a
+    1000-validator header chain (reference validator_set.go:722,775,
+    light/verifier.go:32). Device path = verify_chain_batched: every
+    signature across the range rides ONE device call."""
+    from tendermint_tpu.crypto.batch import BatchVerifier, precomputed_verdicts
+
+    n_vals, n_headers = 1000, 8
+    vs, keys = _mk_val_set(n_vals)
+    commits = [_sign_commit(vs, keys, h, "bench-light")[0]
+               for h in range(2, n_headers + 2)]
+    trust = (1, 3)
+
+    def verify_chain_device():
+        # the chain-batched pattern: batch ALL sigs, then replay semantics
+        bv = BatchVerifier(backend="jax")
+        pre_keys = []
+        for c in commits:
+            for idx, cs in enumerate(c.signatures):
+                if cs.for_block():
+                    pk = vs.validators[idx].pub_key
+                    sb = c.vote_sign_bytes("bench-light", idx)
+                    bv.add(pk, sb, cs.signature)
+                    pre_keys.append((pk.bytes(), sb, cs.signature))
+        _, verdicts = bv.verify()
+        token = precomputed_verdicts.set(
+            {k: bool(v) for k, v in zip(pre_keys, verdicts)})
+        try:
+            for c in commits:
+                vs.verify_commit_light_trusting("bench-light", c, trust)
+                vs.verify_commit_light("bench-light", c.block_id, c.height, c)
+        finally:
+            precomputed_verdicts.reset(token)
+
+    def verify_chain():
+        for c in commits:
+            vs.verify_commit_light_trusting("bench-light", c, trust)
+            vs.verify_commit_light("bench-light", c.block_id, c.height, c)
+
+    dev = _timed(verify_chain_device)
+    os.environ["TMTPU_BATCH_BACKEND"] = "host"
+    try:
+        host = _timed(verify_chain, warm=0, runs=1)
+    finally:
+        del os.environ["TMTPU_BATCH_BACKEND"]
+    # sigs verified per pass: trusting tallies ~all, light stops at 2/3
+    sigs = n_headers * (n_vals + 2 * n_vals // 3 + 1)
+    print(json.dumps({
+        "metric": "light_chain_1000_vals_sigs_per_sec",
+        "value": round(sigs / dev, 1), "unit": "sigs/s",
+        "vs_baseline": round(host / dev, 3),
+    }))
+
+
+def bench_fast_sync_replay():
+    """Config #5 (scaled): the block-sync engine's windowed batched commit
+    verification over a 1000-validator chain (reference
+    blockchain/v0/reactor.go:255; our blockchain/reactor.py:186). Measures
+    the verification plane, which is the reference's fast-sync bottleneck."""
+    from tendermint_tpu.types.validator_set import verify_commit_light_batched
+
+    n_vals, n_blocks, window = 1000, 64, 16
+    vs, keys = _mk_val_set(n_vals)
+    entries = []
+    for h in range(1, n_blocks + 1):
+        commit, bid = _sign_commit(vs, keys, h, "bench-sync")
+        entries.append((vs, "bench-sync", bid, h, commit))
+
+    def replay():
+        for i in range(0, n_blocks, window):
+            errs = verify_commit_light_batched(entries[i:i + window])
+            assert all(e is None for e in errs), errs
+
+    dev = _timed(replay)
+    os.environ["TMTPU_BATCH_BACKEND"] = "host"
+    try:
+        host = _timed(replay, warm=0, runs=1)
+    finally:
+        del os.environ["TMTPU_BATCH_BACKEND"]
+    print(json.dumps({
+        "metric": "fast_sync_1000_vals_blocks_per_sec",
+        "value": round(n_blocks / dev, 2), "unit": "blocks/s",
+        "vs_baseline": round(host / dev, 3),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=1, choices=(1, 2, 3, 5),
+                    help="BASELINE.json config: 1=batch stream (default, the "
+                         "driver metric), 2=VerifyCommit@150, 3=light chain "
+                         "@1000, 5=fast-sync replay @1000")
+    args = ap.parse_args()
+    {1: main, 2: bench_verify_commit_150, 3: bench_light_chain_1000,
+     5: bench_fast_sync_replay}[args.config]()

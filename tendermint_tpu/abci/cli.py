@@ -1,0 +1,87 @@
+"""abci-cli: exercise an ABCI app over its socket
+(reference abci/cmd/abci-cli — echo, info, deliver_tx, check_tx, commit,
+query, plus a console mode).
+
+Usage:
+    python -m tendermint_tpu.abci.cli --address tcp://127.0.0.1:26658 info
+    python -m tendermint_tpu.abci.cli deliver_tx 0x6b3d76   # or "k=v"
+    python -m tendermint_tpu.abci.cli console
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import types as abci
+from .client import SocketClient
+
+
+def _parse_bytes(s: str) -> bytes:
+    if s.startswith("0x"):
+        return bytes.fromhex(s[2:])
+    return s.encode()
+
+
+def run_command(client: SocketClient, cmd: str, args) -> int:
+    if cmd == "echo":
+        print(client.echo(args[0] if args else ""))
+    elif cmd == "info":
+        r = client.info(abci.RequestInfo())
+        print(f"-> data: {r.data}\n-> last_block_height: {r.last_block_height}"
+              f"\n-> last_block_app_hash: 0x{r.last_block_app_hash.hex()}")
+    elif cmd == "deliver_tx":
+        r = client.deliver_tx(abci.RequestDeliverTx(tx=_parse_bytes(args[0])))
+        print(f"-> code: {r.code}\n-> data: 0x{r.data.hex()}\n-> log: {r.log}")
+    elif cmd == "check_tx":
+        r = client.check_tx(abci.RequestCheckTx(tx=_parse_bytes(args[0])))
+        print(f"-> code: {r.code}\n-> log: {r.log}")
+    elif cmd == "commit":
+        r = client.commit()
+        print(f"-> data: 0x{r.data.hex()}")
+    elif cmd == "query":
+        r = client.query(abci.RequestQuery(data=_parse_bytes(args[0])))
+        print(f"-> code: {r.code}\n-> key: {r.key.decode(errors='replace')}"
+              f"\n-> value: {r.value.decode(errors='replace')}\n-> log: {r.log}")
+    else:
+        print(f"unknown command {cmd!r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def console(client: SocketClient) -> int:
+    print("> type a command (echo/info/deliver_tx/check_tx/commit/query), "
+          "ctrl-d to exit")
+    while True:
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            return 0
+        if not line:
+            continue
+        parts = line.split()
+        try:
+            run_command(client, parts[0], parts[1:])
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="abci-cli")
+    p.add_argument("--address", default="tcp://127.0.0.1:26658")
+    p.add_argument("command", choices=["echo", "info", "deliver_tx",
+                                       "check_tx", "commit", "query",
+                                       "console"])
+    p.add_argument("args", nargs="*")
+    ns = p.parse_args(argv)
+    client = SocketClient(ns.address)
+    try:
+        if ns.command == "console":
+            return console(client)
+        return run_command(client, ns.command, ns.args)
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,22 +169,6 @@ def _from_jsonable(obj: Any) -> Any:
     return obj
 
 
-def write_frame(sock: socket.socket, payload: dict) -> None:
-    raw = json.dumps(_to_jsonable(payload)).encode("utf-8")
-    sock.sendall(struct.pack(">I", len(raw)) + raw)
-
-
-def read_frame(sock: socket.socket) -> Optional[dict]:
-    hdr = _read_exact(sock, 4)
-    if hdr is None:
-        return None
-    (ln,) = struct.unpack(">I", hdr)
-    raw = _read_exact(sock, ln)
-    if raw is None:
-        return None
-    return _from_jsonable(json.loads(raw.decode("utf-8")))
-
-
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     buf = b""
     while len(buf) < n:
@@ -194,29 +178,6 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
         buf += chunk
     return buf
 
-
-_REQ_TYPES = {
-    "info": abci.RequestInfo, "init_chain": abci.RequestInitChain,
-    "query": abci.RequestQuery, "check_tx": abci.RequestCheckTx,
-    "begin_block": abci.RequestBeginBlock, "deliver_tx": abci.RequestDeliverTx,
-    "end_block": abci.RequestEndBlock, "commit": None,
-    "list_snapshots": abci.RequestListSnapshots,
-    "offer_snapshot": abci.RequestOfferSnapshot,
-    "load_snapshot_chunk": abci.RequestLoadSnapshotChunk,
-    "apply_snapshot_chunk": abci.RequestApplySnapshotChunk,
-    "echo": None, "flush": None,
-}
-
-_RESP_TYPES = {
-    "info": abci.ResponseInfo, "init_chain": abci.ResponseInitChain,
-    "query": abci.ResponseQuery, "check_tx": abci.ResponseCheckTx,
-    "begin_block": abci.ResponseBeginBlock, "deliver_tx": abci.ResponseDeliverTx,
-    "end_block": abci.ResponseEndBlock, "commit": abci.ResponseCommit,
-    "list_snapshots": abci.ResponseListSnapshots,
-    "offer_snapshot": abci.ResponseOfferSnapshot,
-    "load_snapshot_chunk": abci.ResponseLoadSnapshotChunk,
-    "apply_snapshot_chunk": abci.ResponseApplySnapshotChunk,
-}
 
 
 def _rebuild(cls, data):
@@ -248,31 +209,69 @@ def _rebuild(cls, data):
     return cls(**kwargs)
 
 
+def read_proto_frame(sock: socket.socket) -> Optional[bytes]:
+    """One uvarint-length-delimited message body, or None on EOF."""
+    length = 0
+    shift = 0
+    while True:
+        b = _read_exact(sock, 1)
+        if b is None:
+            return None
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise ABCIClientError("varint length overflow")
+    if length > 104857600:  # 100 MB sanity cap (socket framing guard)
+        raise ABCIClientError(f"ABCI message too large: {length}")
+    body = _read_exact(sock, length)
+    if body is None:
+        return None
+    return body
+
+
 class SocketClient(Client):
-    """Length-prefixed framed client for out-of-process apps (reference
-    abci/client/socket_client.go:27 — framing is ours, semantics theirs)."""
+    """Out-of-process client speaking the reference's wire format: uvarint-
+    length-delimited protobuf Request/Response envelopes with explicit flush
+    (reference abci/client/socket_client.go:27) — wire-compatible with
+    reference-built ABCI apps."""
 
     def __init__(self, addr: str):
+        from .proto_codec import decode_response, encode_request
+
         self._addr = addr
         self._sock = _dial(addr)
         self._mtx = threading.Lock()
+        self._encode_request = encode_request
+        self._decode_response = decode_response
 
     def _call(self, method: str, req: Any = None) -> Any:
         with self._mtx:
-            write_frame(self._sock, {"method": method,
-                                     "request": _to_jsonable(req) if req is not None else None})
-            resp = read_frame(self._sock)
-        if resp is None:
-            raise ABCIClientError(f"connection closed during {method}")
-        if resp.get("error"):
-            raise ABCIClientError(resp["error"])
-        return _rebuild(_RESP_TYPES.get(method), resp.get("response"))
+            # request + flush, then read until this method's response arrives
+            # (reference apps buffer responses until a flush)
+            self._sock.sendall(self._encode_request(method, req)
+                               + self._encode_request("flush", None))
+            while True:
+                body = read_proto_frame(self._sock)
+                if body is None:
+                    raise ABCIClientError(f"connection closed during {method}")
+                got, resp = self._decode_response(body)
+                if got == "exception":
+                    raise ABCIClientError(resp)
+                if got == method:
+                    # drain the flush ack
+                    fl = read_proto_frame(self._sock)
+                    if fl is not None:
+                        self._decode_response(fl)
+                    return resp
+                if got == "flush":
+                    continue
+                raise ABCIClientError(
+                    f"unexpected {got!r} response to {method!r}")
 
     def echo(self, msg: str) -> str:
-        with self._mtx:
-            write_frame(self._sock, {"method": "echo", "request": {"message": msg}})
-            resp = read_frame(self._sock)
-        return (resp or {}).get("response", {}).get("message", "")
+        return self._call("echo", msg)
 
     def info(self, req):
         return self._call("info", req)

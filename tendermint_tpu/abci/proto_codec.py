@@ -1,0 +1,658 @@
+"""ABCI protobuf wire codec (reference proto/tendermint/abci/types.proto +
+abci/client/socket_client.go:27 framing).
+
+Encodes/decodes the Request/Response oneof envelopes with the exact gogoproto
+field numbers, framed as uvarint-length-delimited messages (libs/protoio) —
+so reference-compatible out-of-process ABCI apps can attach to this node's
+socket client, and reference nodes can drive apps served by our server.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from ..libs import protowire as pw
+from . import types as abci
+
+# oneof field numbers (types.proto:23-38 / :131-148)
+REQ_FIELDS = {
+    "echo": 1, "flush": 2, "info": 3, "set_option": 4, "init_chain": 5,
+    "query": 6, "begin_block": 7, "check_tx": 8, "deliver_tx": 9,
+    "end_block": 10, "commit": 11, "list_snapshots": 12, "offer_snapshot": 13,
+    "load_snapshot_chunk": 14, "apply_snapshot_chunk": 15,
+}
+REQ_BY_FIELD = {v: k for k, v in REQ_FIELDS.items()}
+RESP_FIELDS = {
+    "exception": 1, "echo": 2, "flush": 3, "info": 4, "set_option": 5,
+    "init_chain": 6, "query": 7, "begin_block": 8, "check_tx": 9,
+    "deliver_tx": 10, "end_block": 11, "commit": 12, "list_snapshots": 13,
+    "offer_snapshot": 14, "load_snapshot_chunk": 15,
+    "apply_snapshot_chunk": 16,
+}
+RESP_BY_FIELD = {v: k for k, v in RESP_FIELDS.items()}
+
+_EVIDENCE_TYPES = {"": 0, "UNKNOWN": 0, "DUPLICATE_VOTE": 1,
+                   "LIGHT_CLIENT_ATTACK": 2}
+_EVIDENCE_NAMES = {v: k for k, v in _EVIDENCE_TYPES.items() if k}
+_EVIDENCE_NAMES[0] = "UNKNOWN"
+
+
+# --- shared sub-messages ----------------------------------------------------
+
+def _enc_event(ev: abci.Event) -> bytes:
+    w = pw.Writer()
+    w.string(1, ev.type)
+    for a in ev.attributes:
+        aw = pw.Writer()
+        aw.bytes(1, a.key)
+        aw.bytes(2, a.value)
+        if a.index:
+            aw.bool(3, True)
+        w.message(2, aw.finish())
+    return w.finish()
+
+
+def _dec_event(body: bytes) -> abci.Event:
+    ev = abci.Event()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            ev.type = v.decode()
+        elif fn == 2:
+            a = abci.EventAttribute()
+            for afn, _awt, av in pw.iter_fields(v):
+                if afn == 1:
+                    a.key = av
+                elif afn == 2:
+                    a.value = av
+                elif afn == 3:
+                    a.index = bool(av)
+            ev.attributes.append(a)
+    return ev
+
+
+def _enc_validator(v: abci.ABCIValidator) -> bytes:
+    w = pw.Writer()
+    w.bytes(1, v.address)
+    w.varint(3, v.power)
+    return w.finish()
+
+
+def _dec_validator(body: bytes) -> abci.ABCIValidator:
+    out = abci.ABCIValidator()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            out.address = v
+        elif fn == 3:
+            out.power = pw.varint_to_int64(v)
+    return out
+
+
+def _enc_validator_update(vu: abci.ValidatorUpdate) -> bytes:
+    pk = pw.Writer()
+    pk.bytes(1 if vu.pub_key_type == "ed25519" else 2, vu.pub_key_bytes)
+    w = pw.Writer()
+    w.message(1, pk.finish())
+    w.varint(2, vu.power)
+    return w.finish()
+
+
+def _dec_validator_update(body: bytes) -> abci.ValidatorUpdate:
+    out = abci.ValidatorUpdate()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            for pfn, _pwt, pv in pw.iter_fields(v):
+                out.pub_key_type = "ed25519" if pfn == 1 else "secp256k1"
+                out.pub_key_bytes = pv
+        elif fn == 2:
+            out.power = pw.varint_to_int64(v)
+    return out
+
+
+def _enc_last_commit_info(lci: abci.LastCommitInfo) -> bytes:
+    w = pw.Writer()
+    if lci.round:
+        w.varint(1, lci.round)
+    for vi in lci.votes:
+        vw = pw.Writer()
+        vw.message(1, _enc_validator(vi.validator))
+        if vi.signed_last_block:
+            vw.bool(2, True)
+        w.message(2, vw.finish())
+    return w.finish()
+
+
+def _dec_last_commit_info(body: bytes) -> abci.LastCommitInfo:
+    out = abci.LastCommitInfo()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            out.round = pw.varint_to_int64(v)
+        elif fn == 2:
+            vi = abci.VoteInfo()
+            for vfn, _vwt, vv in pw.iter_fields(v):
+                if vfn == 1:
+                    vi.validator = _dec_validator(vv)
+                elif vfn == 2:
+                    vi.signed_last_block = bool(vv)
+            out.votes.append(vi)
+    return out
+
+
+def _enc_evidence(e: abci.ABCIEvidence) -> bytes:
+    w = pw.Writer()
+    t = _EVIDENCE_TYPES.get(e.type, 0)
+    if t:
+        w.varint(1, t)
+    w.message(2, _enc_validator(e.validator))
+    if e.height:
+        w.varint(3, e.height)
+    w.message(4, _ts_body(e.time_ns))
+    if e.total_voting_power:
+        w.varint(5, e.total_voting_power)
+    return w.finish()
+
+
+def _ts_body(ns: int) -> bytes:
+    w = pw.Writer()
+    secs, nanos = divmod(ns, 1_000_000_000)
+    if secs:
+        w.varint(1, secs)
+    if nanos:
+        w.varint(2, nanos)
+    return w.finish()
+
+
+def _dec_ts(body: bytes) -> int:
+    return pw.parse_timestamp(body)
+
+
+def _dec_evidence(body: bytes) -> abci.ABCIEvidence:
+    out = abci.ABCIEvidence()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            out.type = _EVIDENCE_NAMES.get(pw.varint_to_int64(v), "UNKNOWN")
+        elif fn == 2:
+            out.validator = _dec_validator(v)
+        elif fn == 3:
+            out.height = pw.varint_to_int64(v)
+        elif fn == 4:
+            out.time_ns = _dec_ts(v)
+        elif fn == 5:
+            out.total_voting_power = pw.varint_to_int64(v)
+    return out
+
+
+def _enc_snapshot(s: abci.Snapshot) -> bytes:
+    w = pw.Writer()
+    if s.height:
+        w.varint(1, s.height)
+    if s.format:
+        w.varint(2, s.format)
+    if s.chunks:
+        w.varint(3, s.chunks)
+    if s.hash:
+        w.bytes(4, s.hash)
+    if s.metadata:
+        w.bytes(5, s.metadata)
+    return w.finish()
+
+
+def _dec_snapshot(body: bytes) -> abci.Snapshot:
+    out = abci.Snapshot()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            out.height = pw.varint_to_int64(v)
+        elif fn == 2:
+            out.format = pw.varint_to_int64(v)
+        elif fn == 3:
+            out.chunks = pw.varint_to_int64(v)
+        elif fn == 4:
+            out.hash = v
+        elif fn == 5:
+            out.metadata = v
+    return out
+
+
+def _enc_consensus_params(cp: abci.ABCIConsensusParams) -> bytes:
+    w = pw.Writer()
+    if cp.block is not None:
+        bw = pw.Writer()
+        if cp.block.max_bytes:
+            bw.varint(1, cp.block.max_bytes)
+        if cp.block.max_gas:
+            bw.varint(2, cp.block.max_gas)
+        w.message(1, bw.finish())
+    if cp.evidence is not None:
+        ew = pw.Writer()
+        if cp.evidence.max_age_num_blocks:
+            ew.varint(1, cp.evidence.max_age_num_blocks)
+        if cp.evidence.max_age_duration_ns:
+            dw = pw.Writer()
+            secs, nanos = divmod(cp.evidence.max_age_duration_ns, 1_000_000_000)
+            if secs:
+                dw.varint(1, secs)
+            if nanos:
+                dw.varint(2, nanos)
+            ew.message(2, dw.finish())
+        if cp.evidence.max_bytes:
+            ew.varint(3, cp.evidence.max_bytes)
+        w.message(2, ew.finish())
+    if cp.validator is not None:
+        vw = pw.Writer()
+        for t in cp.validator.pub_key_types:
+            vw.string(1, t)
+        w.message(3, vw.finish())
+    if cp.version is not None:
+        vw = pw.Writer()
+        if cp.version.app_version:
+            vw.varint(1, cp.version.app_version)
+        w.message(4, vw.finish())
+    return w.finish()
+
+
+def _dec_consensus_params(body: bytes) -> abci.ABCIConsensusParams:
+    out = abci.ABCIConsensusParams()
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            b = abci.ABCIBlockParams()
+            for bfn, _bwt, bv in pw.iter_fields(v):
+                if bfn == 1:
+                    b.max_bytes = pw.varint_to_int64(bv)
+                elif bfn == 2:
+                    b.max_gas = pw.varint_to_int64(bv)
+            out.block = b
+        elif fn == 2:
+            e = abci.ABCIEvidenceParams()
+            for efn, _ewt, ev in pw.iter_fields(v):
+                if efn == 1:
+                    e.max_age_num_blocks = pw.varint_to_int64(ev)
+                elif efn == 2:
+                    e.max_age_duration_ns = _dec_duration(ev)
+                elif efn == 3:
+                    e.max_bytes = pw.varint_to_int64(ev)
+            out.evidence = e
+        elif fn == 3:
+            vp = abci.ABCIValidatorParams()
+            for vfn, _vwt, vv in pw.iter_fields(v):
+                if vfn == 1:
+                    vp.pub_key_types.append(vv.decode())
+            out.validator = vp
+        elif fn == 4:
+            ver = abci.ABCIVersionParams()
+            for vfn, _vwt, vv in pw.iter_fields(v):
+                if vfn == 1:
+                    ver.app_version = pw.varint_to_int64(vv)
+            out.version = ver
+    return out
+
+
+def _dec_duration(body: bytes) -> int:
+    secs = nanos = 0
+    for fn, _wt, v in pw.iter_fields(body):
+        if fn == 1:
+            secs = pw.varint_to_int64(v)
+        elif fn == 2:
+            nanos = pw.varint_to_int64(v)
+    return secs * 1_000_000_000 + nanos
+
+
+# --- per-message request codecs ---------------------------------------------
+
+def _enc_request_body(method: str, req: Any) -> bytes:
+    w = pw.Writer()
+    if method == "echo":
+        w.string(1, req)
+    elif method in ("flush", "commit", "list_snapshots"):
+        pass
+    elif method == "info":
+        if req.version:
+            w.string(1, req.version)
+        if req.block_version:
+            w.varint(2, req.block_version)
+        if req.p2p_version:
+            w.varint(3, req.p2p_version)
+    elif method == "init_chain":
+        w.message(1, _ts_body(req.time_ns))
+        w.string(2, req.chain_id)
+        if req.consensus_params is not None:
+            w.message(3, _enc_consensus_params(req.consensus_params))
+        for vu in req.validators:
+            w.message(4, _enc_validator_update(vu))
+        if req.app_state_bytes:
+            w.bytes(5, req.app_state_bytes)
+        if req.initial_height:
+            w.varint(6, req.initial_height)
+    elif method == "query":
+        if req.data:
+            w.bytes(1, req.data)
+        if req.path:
+            w.string(2, req.path)
+        if req.height:
+            w.varint(3, req.height)
+        if req.prove:
+            w.bool(4, True)
+    elif method == "begin_block":
+        if req.hash:
+            w.bytes(1, req.hash)
+        if req.header is not None:
+            w.message(2, req.header.encode())
+        w.message(3, _enc_last_commit_info(req.last_commit_info))
+        for e in req.byzantine_validators:
+            w.message(4, _enc_evidence(e))
+    elif method == "check_tx":
+        if req.tx:
+            w.bytes(1, req.tx)
+        if req.type:
+            w.varint(2, req.type)
+    elif method == "deliver_tx":
+        if req.tx:
+            w.bytes(1, req.tx)
+    elif method == "end_block":
+        if req.height:
+            w.varint(1, req.height)
+    elif method == "offer_snapshot":
+        if req.snapshot is not None:
+            w.message(1, _enc_snapshot(req.snapshot))
+        if req.app_hash:
+            w.bytes(2, req.app_hash)
+    elif method == "load_snapshot_chunk":
+        if req.height:
+            w.varint(1, req.height)
+        if req.format:
+            w.varint(2, req.format)
+        if req.chunk:
+            w.varint(3, req.chunk)
+    elif method == "apply_snapshot_chunk":
+        if req.index:
+            w.varint(1, req.index)
+        if req.chunk:
+            w.bytes(2, req.chunk)
+        if req.sender:
+            w.string(3, req.sender)
+    else:
+        raise ValueError(f"unknown request method {method!r}")
+    return w.finish()
+
+
+def _dec_request_body(method: str, body: bytes) -> Any:
+    f = pw.fields_dict(body) if body else {}
+
+    def get(n, default=None):
+        return f.get(n, [default])[0]
+
+    if method == "echo":
+        return (get(1, b"") or b"").decode()
+    if method in ("flush", "commit", "list_snapshots"):
+        return None
+    if method == "info":
+        return abci.RequestInfo(
+            version=(get(1, b"") or b"").decode(),
+            block_version=pw.varint_to_int64(get(2, 0) or 0),
+            p2p_version=pw.varint_to_int64(get(3, 0) or 0))
+    if method == "init_chain":
+        return abci.RequestInitChain(
+            time_ns=_dec_ts(get(1, b"") or b""),
+            chain_id=(get(2, b"") or b"").decode(),
+            consensus_params=(_dec_consensus_params(get(3))
+                              if get(3) is not None else None),
+            validators=[_dec_validator_update(v) for v in f.get(4, [])],
+            app_state_bytes=get(5, b"") or b"",
+            initial_height=pw.varint_to_int64(get(6, 0) or 0))
+    if method == "query":
+        return abci.RequestQuery(
+            data=get(1, b"") or b"", path=(get(2, b"") or b"").decode(),
+            height=pw.varint_to_int64(get(3, 0) or 0), prove=bool(get(4, 0)))
+    if method == "begin_block":
+        from ..types.block import Header
+
+        hdr = Header.decode(get(2)) if get(2) is not None else None
+        return abci.RequestBeginBlock(
+            hash=get(1, b"") or b"", header=hdr,
+            last_commit_info=_dec_last_commit_info(get(3, b"") or b""),
+            byzantine_validators=[_dec_evidence(v) for v in f.get(4, [])])
+    if method == "check_tx":
+        return abci.RequestCheckTx(tx=get(1, b"") or b"",
+                                   type=pw.varint_to_int64(get(2, 0) or 0))
+    if method == "deliver_tx":
+        return abci.RequestDeliverTx(tx=get(1, b"") or b"")
+    if method == "end_block":
+        return abci.RequestEndBlock(height=pw.varint_to_int64(get(1, 0) or 0))
+    if method == "offer_snapshot":
+        return abci.RequestOfferSnapshot(
+            snapshot=_dec_snapshot(get(1)) if get(1) is not None else None,
+            app_hash=get(2, b"") or b"")
+    if method == "load_snapshot_chunk":
+        return abci.RequestLoadSnapshotChunk(
+            height=pw.varint_to_int64(get(1, 0) or 0),
+            format=pw.varint_to_int64(get(2, 0) or 0),
+            chunk=pw.varint_to_int64(get(3, 0) or 0))
+    if method == "apply_snapshot_chunk":
+        return abci.RequestApplySnapshotChunk(
+            index=pw.varint_to_int64(get(1, 0) or 0),
+            chunk=get(2, b"") or b"",
+            sender=(get(3, b"") or b"").decode())
+    raise ValueError(f"unknown request method {method!r}")
+
+
+# --- per-message response codecs ---------------------------------------------
+
+def _enc_tx_result_common(w: pw.Writer, r) -> None:
+    if r.code:
+        w.varint(1, r.code)
+    if r.data:
+        w.bytes(2, r.data)
+    if r.log:
+        w.string(3, r.log)
+    if r.info:
+        w.string(4, r.info)
+    if r.gas_wanted:
+        w.varint(5, r.gas_wanted)
+    if r.gas_used:
+        w.varint(6, r.gas_used)
+    for ev in r.events:
+        w.message(7, _enc_event(ev))
+    if r.codespace:
+        w.string(8, r.codespace)
+
+
+def _enc_response_body(method: str, resp: Any) -> bytes:
+    w = pw.Writer()
+    if method == "exception":
+        w.string(1, resp)
+    elif method == "echo":
+        w.string(1, resp)
+    elif method == "flush":
+        pass
+    elif method == "info":
+        if resp.data:
+            w.string(1, resp.data)
+        if resp.version:
+            w.string(2, resp.version)
+        if resp.app_version:
+            w.varint(3, resp.app_version)
+        if resp.last_block_height:
+            w.varint(4, resp.last_block_height)
+        if resp.last_block_app_hash:
+            w.bytes(5, resp.last_block_app_hash)
+    elif method == "init_chain":
+        if resp.consensus_params is not None:
+            w.message(1, _enc_consensus_params(resp.consensus_params))
+        for vu in resp.validators:
+            w.message(2, _enc_validator_update(vu))
+        if resp.app_hash:
+            w.bytes(3, resp.app_hash)
+    elif method == "query":
+        if resp.code:
+            w.varint(1, resp.code)
+        if resp.log:
+            w.string(3, resp.log)
+        if resp.info:
+            w.string(4, resp.info)
+        if resp.index:
+            w.varint(5, resp.index)
+        if resp.key:
+            w.bytes(6, resp.key)
+        if resp.value:
+            w.bytes(7, resp.value)
+        if resp.height:
+            w.varint(9, resp.height)
+        if resp.codespace:
+            w.string(10, resp.codespace)
+    elif method == "begin_block":
+        for ev in resp.events:
+            w.message(1, _enc_event(ev))
+    elif method == "check_tx":
+        _enc_tx_result_common(w, resp)
+        if getattr(resp, "sender", ""):
+            w.string(9, resp.sender)
+        if getattr(resp, "priority", 0):
+            w.varint(10, resp.priority)
+        if getattr(resp, "mempool_error", ""):
+            w.string(11, resp.mempool_error)
+    elif method == "deliver_tx":
+        _enc_tx_result_common(w, resp)
+    elif method == "end_block":
+        for vu in resp.validator_updates:
+            w.message(1, _enc_validator_update(vu))
+        if resp.consensus_param_updates is not None:
+            w.message(2, _enc_consensus_params(resp.consensus_param_updates))
+        for ev in resp.events:
+            w.message(3, _enc_event(ev))
+    elif method == "commit":
+        if resp.data:
+            w.bytes(2, resp.data)
+        if resp.retain_height:
+            w.varint(3, resp.retain_height)
+    elif method == "list_snapshots":
+        for s in resp.snapshots:
+            w.message(1, _enc_snapshot(s))
+    elif method == "offer_snapshot":
+        if resp.result:
+            w.varint(1, resp.result)
+    elif method == "load_snapshot_chunk":
+        if resp.chunk:
+            w.bytes(1, resp.chunk)
+    elif method == "apply_snapshot_chunk":
+        if resp.result:
+            w.varint(1, resp.result)
+        for i in resp.refetch_chunks:
+            w.varint(2, i)
+        for s in resp.reject_senders:
+            w.string(3, s)
+    else:
+        raise ValueError(f"unknown response method {method!r}")
+    return w.finish()
+
+
+def _dec_response_body(method: str, body: bytes) -> Any:
+    f = pw.fields_dict(body) if body else {}
+
+    def get(n, default=None):
+        return f.get(n, [default])[0]
+
+    def tx_common(cls):
+        return cls(
+            code=pw.varint_to_int64(get(1, 0) or 0), data=get(2, b"") or b"",
+            log=(get(3, b"") or b"").decode(),
+            info=(get(4, b"") or b"").decode(),
+            gas_wanted=pw.varint_to_int64(get(5, 0) or 0),
+            gas_used=pw.varint_to_int64(get(6, 0) or 0),
+            events=[_dec_event(v) for v in f.get(7, [])],
+            codespace=(get(8, b"") or b"").decode())
+
+    if method == "exception":
+        # callers raise their own error type on this
+        return (get(1, b"") or b"").decode()
+    if method == "echo":
+        return (get(1, b"") or b"").decode()
+    if method == "flush":
+        return None
+    if method == "info":
+        return abci.ResponseInfo(
+            data=(get(1, b"") or b"").decode(),
+            version=(get(2, b"") or b"").decode(),
+            app_version=pw.varint_to_int64(get(3, 0) or 0),
+            last_block_height=pw.varint_to_int64(get(4, 0) or 0),
+            last_block_app_hash=get(5, b"") or b"")
+    if method == "init_chain":
+        return abci.ResponseInitChain(
+            consensus_params=(_dec_consensus_params(get(1))
+                              if get(1) is not None else None),
+            validators=[_dec_validator_update(v) for v in f.get(2, [])],
+            app_hash=get(3, b"") or b"")
+    if method == "query":
+        return abci.ResponseQuery(
+            code=pw.varint_to_int64(get(1, 0) or 0),
+            log=(get(3, b"") or b"").decode(),
+            info=(get(4, b"") or b"").decode(),
+            index=pw.varint_to_int64(get(5, 0) or 0),
+            key=get(6, b"") or b"", value=get(7, b"") or b"",
+            height=pw.varint_to_int64(get(9, 0) or 0),
+            codespace=(get(10, b"") or b"").decode())
+    if method == "begin_block":
+        return abci.ResponseBeginBlock(
+            events=[_dec_event(v) for v in f.get(1, [])])
+    if method == "check_tx":
+        r = tx_common(abci.ResponseCheckTx)
+        r.sender = (get(9, b"") or b"").decode()
+        r.priority = pw.varint_to_int64(get(10, 0) or 0)
+        r.mempool_error = (get(11, b"") or b"").decode()
+        return r
+    if method == "deliver_tx":
+        return tx_common(abci.ResponseDeliverTx)
+    if method == "end_block":
+        return abci.ResponseEndBlock(
+            validator_updates=[_dec_validator_update(v) for v in f.get(1, [])],
+            consensus_param_updates=(_dec_consensus_params(get(2))
+                                     if get(2) is not None else None),
+            events=[_dec_event(v) for v in f.get(3, [])])
+    if method == "commit":
+        return abci.ResponseCommit(
+            data=get(2, b"") or b"",
+            retain_height=pw.varint_to_int64(get(3, 0) or 0))
+    if method == "list_snapshots":
+        return abci.ResponseListSnapshots(
+            snapshots=[_dec_snapshot(v) for v in f.get(1, [])])
+    if method == "offer_snapshot":
+        return abci.ResponseOfferSnapshot(
+            result=pw.varint_to_int64(get(1, 0) or 0))
+    if method == "load_snapshot_chunk":
+        return abci.ResponseLoadSnapshotChunk(chunk=get(1, b"") or b"")
+    if method == "apply_snapshot_chunk":
+        return abci.ResponseApplySnapshotChunk(
+            result=pw.varint_to_int64(get(1, 0) or 0),
+            refetch_chunks=[pw.varint_to_int64(v) for v in f.get(2, [])],
+            reject_senders=[(v or b"").decode() for v in f.get(3, [])])
+    raise ValueError(f"unknown response method {method!r}")
+
+
+# --- envelopes + framing -----------------------------------------------------
+
+def encode_request(method: str, req: Any) -> bytes:
+    """uvarint-length-delimited Request envelope (socket_client.go framing)."""
+    w = pw.Writer()
+    w.message(REQ_FIELDS[method], _enc_request_body(method, req))
+    return pw.length_delimited(w.finish())
+
+
+def encode_response(method: str, resp: Any) -> bytes:
+    w = pw.Writer()
+    w.message(RESP_FIELDS[method], _enc_response_body(method, resp))
+    return pw.length_delimited(w.finish())
+
+
+def decode_request(body: bytes) -> Tuple[str, Any]:
+    for fn, _wt, v in pw.iter_fields(body):
+        method = REQ_BY_FIELD.get(fn)
+        if method is None:
+            raise ValueError(f"unknown request oneof field {fn}")
+        return method, _dec_request_body(method, v)
+    raise ValueError("empty ABCI request")
+
+
+def decode_response(body: bytes) -> Tuple[str, Any]:
+    for fn, _wt, v in pw.iter_fields(body):
+        method = RESP_BY_FIELD.get(fn)
+        if method is None:
+            raise ValueError(f"unknown response oneof field {fn}")
+        return method, _dec_response_body(method, v)
+    raise ValueError("empty ABCI response")

@@ -1,5 +1,7 @@
-"""ABCI socket server: serves an Application to remote SocketClients
-(reference abci/server/socket_server.go:20, with our JSON framing).
+"""ABCI socket server: serves an Application over the reference's wire
+format — uvarint-length-delimited protobuf Request/Response envelopes
+(reference abci/server/socket_server.go:20, proto_codec.py) — so reference
+tendermint nodes can drive apps served here.
 """
 
 from __future__ import annotations
@@ -10,7 +12,8 @@ import threading
 from typing import Optional
 
 from .application import Application
-from .client import _REQ_TYPES, _rebuild, _to_jsonable, read_frame, write_frame
+from .proto_codec import decode_request, encode_response
+from .client import ABCIClientError, read_proto_frame
 
 
 class ABCIServer:
@@ -58,30 +61,40 @@ class ABCIServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         while not self._stopped.is_set():
             try:
-                frame = read_frame(conn)
-            except OSError:
+                body = read_proto_frame(conn)
+            except (OSError, ABCIClientError):
+                # malformed framing (oversized/overflowing varint) or socket
+                # death: close so the peer sees EOF, not a hang
+                try:
+                    conn.close()
+                except OSError:
+                    pass
                 return
-            if frame is None:
+            if body is None:
                 return
-            method = frame.get("method", "")
             try:
+                method, req = decode_request(body)
                 with self._app_mtx:
-                    resp = self._dispatch(method, frame.get("request"))
-                write_frame(conn, {"response": _to_jsonable(resp)})
+                    resp = self._dispatch(method, req)
+                conn.sendall(encode_response(method, resp))
             except Exception as e:  # report, don't kill the conn
-                write_frame(conn, {"error": f"{type(e).__name__}: {e}"})
+                try:
+                    conn.sendall(encode_response(
+                        "exception", f"{type(e).__name__}: {e}"))
+                except OSError:
+                    return
 
-    def _dispatch(self, method: str, raw_req):
+    def _dispatch(self, method: str, req):
         if method == "echo":
-            return {"message": (raw_req or {}).get("message", "")}
+            return req
         if method == "flush":
-            return {}
-        if method == "commit":
+            return None
+        if method in ("commit",):
             return self._app.commit()
-        req_cls = _REQ_TYPES.get(method)
-        if req_cls is None:
-            raise ValueError(f"unknown ABCI method {method!r}")
-        req = _rebuild(req_cls, raw_req or {})
+        if method == "list_snapshots":
+            from . import types as abci
+
+            return self._app.list_snapshots(abci.RequestListSnapshots())
         return getattr(self._app, method)(req)
 
     def stop(self) -> None:

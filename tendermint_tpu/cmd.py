@@ -217,6 +217,23 @@ def cmd_reset_unsafe(args) -> int:
     return 0
 
 
+def cmd_rollback(args) -> int:
+    """(cmd rollback; state/rollback.go) roll state back one height."""
+    from .node import _make_db
+    from .state.rollback import rollback_state
+    from .state.store import StateStore
+    from .store import BlockStore
+
+    cfg = Config.load(args.home)
+    block_store = BlockStore(_make_db(cfg.base.db_backend, cfg.db_dir(),
+                                      "blockstore"))
+    state_store = StateStore(_make_db(cfg.base.db_backend, cfg.db_dir(),
+                                      "state"))
+    height, app_hash = rollback_state(block_store, state_store)
+    print(f"rolled back state to height {height} and hash {app_hash.hex()}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -248,7 +265,8 @@ def main(argv=None) -> int:
                     default=26656)
     sp.set_defaults(fn=cmd_testnet)
 
-    for name, fn in [("gen-node-key", cmd_gen_node_key),
+    for name, fn in [("rollback", cmd_rollback),
+                     ("gen-node-key", cmd_gen_node_key),
                      ("show-node-id", cmd_show_node_id),
                      ("gen-validator", cmd_gen_validator),
                      ("show-validator", cmd_show_validator),

@@ -107,6 +107,7 @@ class ConsensusState:
         # consumes cached verdicts via VoteSet.add_vote.
         from ..crypto.vote_batcher import BatchVoteVerifier
         self.vote_verifier = BatchVoteVerifier()
+        self.metrics = None  # ConsensusMetrics, wired by the node
 
         self._queue: "asyncio.Queue" = asyncio.Queue(maxsize=1000)
         self._timeout_task: Optional[asyncio.Task] = None
@@ -168,6 +169,26 @@ class ConsensusState:
             except asyncio.CancelledError:
                 pass
         self.wal.close()
+
+    def _record_commit_metrics(self, block) -> None:
+        """(consensus/metrics.go series recorded at commit)"""
+        m = self.metrics
+        m.height.set(block.header.height)
+        m.rounds.set(self.rs.round)
+        vals = self.rs.validators
+        if vals is not None:
+            m.validators.set(vals.size())
+            m.validators_power.set(vals.total_voting_power())
+        if block.last_commit is not None:
+            missing = sum(1 for cs in block.last_commit.signatures
+                          if cs.absent())
+            m.missing_validators.set(missing)
+        m.num_txs.set(len(block.data.txs))
+        m.total_txs.inc(len(block.data.txs))
+        if self.state.last_block_time_ns:
+            m.block_interval_seconds.observe(
+                max(0.0, (block.header.time_ns - self.state.last_block_time_ns)
+                    / 1e9))
 
     def _schedule_round0(self) -> None:
         sleep_s = max(0.0, (self.rs.start_time_ns - now_ns()) / 1e9)
@@ -690,6 +711,9 @@ class ConsensusState:
 
         logger.info("finalizing commit of block height=%d hash=%s txs=%d",
                     height, block.hash().hex()[:12], len(block.data.txs))
+
+        if self.metrics is not None:
+            self._record_commit_metrics(block)
 
         if self.block_store.height() < block.header.height:
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()

@@ -1,0 +1,265 @@
+"""Prometheus-style metrics, dependency-free
+(reference per-module metrics.go + prometheus/client_golang).
+
+Counter / Gauge / Histogram with labels, collected in a Registry that
+renders the text exposition format served on the node's
+``instrumentation.prometheus_listen_addr`` /metrics endpoint
+(reference node/node.go:962).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str) -> "_Bound":
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected {len(self.label_names)} "
+                             f"labels, got {len(values)}")
+        return _Bound(self, tuple(str(v) for v in values))
+
+    def _fmt_labels(self, lv: Tuple[str, ...]) -> str:
+        if not lv:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in zip(self.label_names, lv))
+        return "{" + inner + "}"
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for lv, val in items:
+            out.append(f"{self.name}{self._fmt_labels(lv)} {_fmt(val)}")
+        return out
+
+
+class _Bound:
+    __slots__ = ("metric", "lv")
+
+    def __init__(self, metric: "_Metric", lv: Tuple[str, ...]):
+        self.metric = metric
+        self.lv = lv
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.metric._inc(self.lv, amount)
+
+    def set(self, value: float) -> None:
+        self.metric._set(self.lv, value)
+
+    def observe(self, value: float) -> None:
+        self.metric._observe(self.lv, value)
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._inc((), amount)
+
+    def _inc(self, lv: Tuple[str, ...], amount: float) -> None:
+        with self._lock:
+            self._values[lv] = self._values.get(lv, 0.0) + amount
+
+    def _set(self, lv, value):  # pragma: no cover - misuse guard
+        raise TypeError("counters only go up")
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float) -> None:
+        self._set((), value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._inc((), amount)
+
+    def _set(self, lv: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            self._values[lv] = float(value)
+
+    def _inc(self, lv: Tuple[str, ...], amount: float) -> None:
+        with self._lock:
+            self._values[lv] = self._values.get(lv, 0.0) + amount
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0)
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float) -> None:
+        self._observe((), value)
+
+    def _observe(self, lv: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(lv, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[lv] = self._sums.get(lv, 0.0) + value
+            self._totals[lv] = self._totals.get(lv, 0) + 1
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            for lv, counts in items:
+                for b, c in zip(self.buckets, counts):
+                    labels = dict(zip(self.label_names, lv))
+                    labels["le"] = _fmt(b)
+                    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    out.append(f"{self.name}_bucket{{{inner}}} {c}")
+                inf_labels = dict(zip(self.label_names, lv))
+                inf_labels["le"] = "+Inf"
+                inner = ",".join(f'{k}="{v}"' for k, v in inf_labels.items())
+                out.append(f"{self.name}_bucket{{{inner}}} {self._totals[lv]}")
+                out.append(f"{self.name}_sum{self._fmt_labels(lv)} "
+                           f"{_fmt(self._sums[lv])}")
+                out.append(f"{self.name}_count{self._fmt_labels(lv)} "
+                           f"{self._totals[lv]}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint"):
+        self.namespace = namespace
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def counter(self, subsystem: str, name: str, help_: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._add(Counter(self._fq(subsystem, name), help_, labels))
+
+    def gauge(self, subsystem: str, name: str, help_: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._add(Gauge(self._fq(subsystem, name), help_, labels))
+
+    def histogram(self, subsystem: str, name: str, help_: str,
+                  labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._add(Histogram(self._fq(subsystem, name), help_, labels,
+                                   buckets))
+
+    def _fq(self, subsystem: str, name: str) -> str:
+        parts = [p for p in (self.namespace, subsystem, name) if p]
+        return "_".join(parts)
+
+    def _add(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# --- per-module metric sets (reference consensus/metrics.go etc.) -----------
+
+class ConsensusMetrics:
+    """(consensus/metrics.go — the load-bearing subset of its 23 series)"""
+
+    def __init__(self, reg: Registry):
+        g, c, h = reg.gauge, reg.counter, reg.histogram
+        self.height = g("consensus", "height", "Height of the chain.")
+        self.rounds = g("consensus", "rounds", "Round of the chain.")
+        self.validators = g("consensus", "validators",
+                            "Number of validators.")
+        self.validators_power = g("consensus", "validators_power",
+                                  "Total voting power of validators.")
+        self.missing_validators = g("consensus", "missing_validators",
+                                    "Validators missing from the last commit.")
+        self.byzantine_validators = g("consensus", "byzantine_validators",
+                                      "Validators that equivocated.")
+        self.num_txs = g("consensus", "num_txs", "Txs in the latest block.")
+        self.block_size_bytes = g("consensus", "block_size_bytes",
+                                  "Size of the latest block.")
+        self.total_txs = c("consensus", "total_txs", "Total committed txs.")
+        self.block_interval_seconds = h(
+            "consensus", "block_interval_seconds",
+            "Time between this and the last block.")
+        self.fast_syncing = g("consensus", "fast_syncing",
+                              "Whether the node is fast syncing.")
+        self.block_parts = c("consensus", "block_parts",
+                             "Block parts transmitted per peer.", ["peer_id"])
+        self.quorum_prevote_delay = h(
+            "consensus", "quorum_prevote_delay",
+            "Seconds from proposal timestamp to 2/3 prevotes.")
+
+
+class MempoolMetrics:
+    """(mempool/metrics.go)"""
+
+    def __init__(self, reg: Registry):
+        self.size = reg.gauge("mempool", "size", "Number of uncommitted txs.")
+        self.tx_size_bytes = reg.histogram(
+            "mempool", "tx_size_bytes", "Tx sizes in bytes.",
+            buckets=(32, 128, 512, 2048, 8192, 32768, 131072))
+        self.failed_txs = reg.counter("mempool", "failed_txs",
+                                      "Txs that failed CheckTx.")
+        self.recheck_times = reg.counter("mempool", "recheck_times",
+                                         "Times txs were rechecked.")
+
+
+class P2PMetrics:
+    """(p2p/metrics.go)"""
+
+    def __init__(self, reg: Registry):
+        self.peers = reg.gauge("p2p", "peers", "Connected peers.")
+        self.peer_receive_bytes_total = reg.counter(
+            "p2p", "peer_receive_bytes_total",
+            "Bytes received per channel.", ["chID"])
+        self.peer_send_bytes_total = reg.counter(
+            "p2p", "peer_send_bytes_total",
+            "Bytes sent per channel.", ["chID"])
+
+
+class StateMetrics:
+    """(state/metrics.go)"""
+
+    def __init__(self, reg: Registry):
+        self.block_processing_time = reg.histogram(
+            "state", "block_processing_time",
+            "Seconds in ApplyBlock.", buckets=(0.001, 0.005, 0.01, 0.025,
+                                               0.05, 0.1, 0.25, 0.5, 1.0))
+
+
+class NodeMetrics:
+    """All module metric sets over one registry (node/node.go:117
+    MetricsProvider)."""
+
+    def __init__(self, namespace: str = "tendermint"):
+        self.registry = Registry(namespace)
+        self.consensus = ConsensusMetrics(self.registry)
+        self.mempool = MempoolMetrics(self.registry)
+        self.p2p = P2PMetrics(self.registry)
+        self.state = StateMetrics(self.registry)

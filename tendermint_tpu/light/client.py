@@ -154,19 +154,22 @@ class LightClient:
 
     async def _verify_sequential(self, trusted: LightBlock, new_lb: LightBlock,
                                  now_ns: int) -> None:
-        """(light/client.go:613 verifySequential)"""
+        """(light/client.go:613 verifySequential) — TPU-first: the whole
+        range is fetched, then every commit signature across it rides ONE
+        batched device call (verifier.verify_chain_batched)."""
+        from .verifier import verify_chain_batched
+
+        chain = []
         for h in range(trusted.signed_header.header.height + 1,
                        new_lb.signed_header.header.height):
             inter = await self.primary.light_block(h)
             inter.validate_basic(self.chain_id)
-            verify_adjacent(trusted.signed_header, inter.signed_header,
-                            inter.validator_set, self.trust_options.period_s,
-                            now_ns, self.max_clock_drift_s)
-            self.store.save(inter)
-            trusted = inter
-        verify_adjacent(trusted.signed_header, new_lb.signed_header,
-                        new_lb.validator_set, self.trust_options.period_s,
-                        now_ns, self.max_clock_drift_s)
+            chain.append(inter)
+        chain.append(new_lb)
+        verify_chain_batched(trusted, chain, self.trust_options.period_s,
+                             now_ns, self.max_clock_drift_s, self.trust_level)
+        for lb in chain[:-1]:
+            self.store.save(lb)
 
     async def _verify_skipping(self, trusted: LightBlock, new_lb: LightBlock,
                                now_ns: int) -> None:

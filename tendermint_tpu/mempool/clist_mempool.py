@@ -70,6 +70,7 @@ class CListMempool:
                  keep_invalid_txs_in_cache: bool = False,
                  recheck: bool = True):
         self._proxy_app = proxy_app
+        self.metrics = None  # MempoolMetrics, wired by the node
         self._height = height
         self._max_txs = max_txs
         self._max_txs_bytes = max_txs_bytes
@@ -130,6 +131,10 @@ class CListMempool:
                 raise ErrTxInCache()
 
             res = self._proxy_app.check_tx(abci.RequestCheckTx(tx=tx))
+            if self.metrics is not None:
+                self.metrics.tx_size_bytes.observe(len(tx))
+                if res.code != 0:
+                    self.metrics.failed_txs.inc()
             if self.post_check is not None:
                 self.post_check(tx, res)
             if res.is_ok():
@@ -138,6 +143,8 @@ class CListMempool:
                                    {sender} if sender else set(), key)
                 self._txs[key] = mem_tx
                 self._txs_bytes += len(tx)
+                if self.metrics is not None:
+                    self.metrics.size.set(len(self._txs))
                 self._notify_txs_available()
             else:
                 if not self._keep_invalid:
@@ -191,11 +198,15 @@ class CListMempool:
             self._recheck_txs()
         if self._txs:
             self._notify_txs_available()
+        if self.metrics is not None:
+            self.metrics.size.set(len(self._txs))
 
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on remaining txs post-block (clist_mempool.go:641)."""
         for key in list(self._txs.keys()):
             mem_tx = self._txs[key]
+            if self.metrics is not None:
+                self.metrics.recheck_times.inc()
             res = self._proxy_app.check_tx(abci.RequestCheckTx(
                 tx=mem_tx.tx, type=abci.CHECK_TX_TYPE_RECHECK))
             if self.post_check is not None:

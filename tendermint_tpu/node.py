@@ -152,6 +152,14 @@ class Node:
             consensus_reactor=self.consensus_reactor, on_fatal=_on_fatal)
         self._fast_sync = fast_sync
 
+        # -- metrics (node.go:117 MetricsProvider; served on /metrics) ------
+        from .libs.metrics import NodeMetrics
+
+        self.metrics = NodeMetrics(config.instrumentation.namespace)
+        self.consensus_state.metrics = self.metrics.consensus
+        self.mempool.metrics = self.metrics.mempool
+        self.block_exec.metrics = self.metrics.state
+
         # -- tx/block indexer (node.go:745 createAndStartIndexerService) ----
         self.indexer_service = None
         self.tx_indexer = None
@@ -194,6 +202,23 @@ class Node:
             send_rate=config.p2p.send_rate, recv_rate=config.p2p.recv_rate,
             max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
             flush_throttle=config.p2p.flush_throttle_timeout)
+        # PEX + address book (node.go:872,600; p2p/pex.py)
+        if config.p2p.pex:
+            from .p2p.pex import AddrBook, PEXReactor
+
+            self.addr_book = AddrBook(
+                config._rootify(config.p2p.addr_book_file),
+                strict=config.p2p.addr_book_strict)
+            self.addr_book.add_our_address(node_key.id)
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                target_outbound=config.p2p.max_num_outbound_peers)
+            reactors["PEX"] = self.pex_reactor
+            descs.extend(self.pex_reactor.get_channels())
+        else:
+            self.addr_book = None
+            self.pex_reactor = None
+
         self.transport = TCPTransport(node_key, self.node_info, descs, mconn_cfg)
         self.switch = Switch(node_key.id, transport=self.transport)
         for name, r in reactors.items():
@@ -246,6 +271,8 @@ class Node:
         self._started = True
         if self.indexer_service is not None:
             await self.indexer_service.start()
+        if self.config.instrumentation.prometheus:
+            await self._start_metrics_server()
         if self.rpc_server is not None:
             await self.rpc_server.start(self.config.rpc.laddr)
         await self.switch.start()
@@ -269,6 +296,27 @@ class Node:
             self.switch.dial_peers_async(peers, persistent=True)
         logger.info("node %s started: p2p=%s rpc=%s", self.node_key.id[:8],
                     self.listen_addr, self.config.rpc.laddr or "off")
+
+    async def _start_metrics_server(self) -> None:
+        """(node.go:962) /metrics in Prometheus text format."""
+        from aiohttp import web
+
+        async def metrics(request):
+            self.metrics.p2p.peers.set(len(self.switch.peers))
+            return web.Response(text=self.metrics.registry.render(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics)
+        self._metrics_runner = web.AppRunner(app, access_log=None)
+        await self._metrics_runner.setup()
+        addr = self.config.instrumentation.prometheus_listen_addr
+        addr = addr.split("://", 1)[-1]  # accept tcp://host:port like laddrs
+        host, _, port = addr.rpartition(":")
+        site = web.TCPSite(self._metrics_runner, host or "127.0.0.1", int(port))
+        await site.start()
+        self.metrics_port = (self._metrics_runner.addresses[0][1]
+                             if self._metrics_runner.addresses else int(port))
 
     async def _run_state_sync(self) -> None:
         """(node.go:648 startStateSync) snapshot restore → bootstrap stores →
@@ -311,6 +359,9 @@ class Node:
         await self.switch.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        runner = getattr(self, "_metrics_runner", None)
+        if runner is not None:
+            await runner.cleanup()
         self.proxy_app.stop()
 
 

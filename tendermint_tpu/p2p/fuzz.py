@@ -1,0 +1,79 @@
+"""FuzzedConnection: fault-injecting connection wrapper
+(reference p2p/fuzz.go:14, config/config.go:663 FuzzConnConfig).
+
+Wraps a SecretConnection-shaped object and probabilistically drops or
+delays reads/writes — the runtime fault-injection half of the QA story
+(the e2e perturbations being the process-level half).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+
+
+@dataclass
+class FuzzConnConfig:
+    """(config.go:663 DefaultFuzzConnConfig)"""
+
+    mode: str = "drop"        # "drop" | "delay"
+    prob_drop_rw: float = 0.2
+    prob_drop_conn: float = 0.0
+    max_delay_s: float = 3.0
+    seed: int = 0
+
+
+class FuzzedConnection:
+    """Duck-types the SecretConnection surface used by MConnection."""
+
+    def __init__(self, conn, config: FuzzConnConfig = None):
+        self.conn = conn
+        self.config = config or FuzzConnConfig()
+        self._rng = random.Random(self.config.seed or None)
+        self.dropped_reads = 0
+        self.dropped_writes = 0
+
+    async def _fuzz(self) -> bool:
+        """True = drop this operation."""
+        cfg = self.config
+        if cfg.mode == "drop":
+            if cfg.prob_drop_conn and self._rng.random() < cfg.prob_drop_conn:
+                self.close()
+                raise ConnectionError("fuzzed connection dropped")
+            return self._rng.random() < cfg.prob_drop_rw
+        if cfg.mode == "delay":
+            await asyncio.sleep(self._rng.random() * cfg.max_delay_s)
+        return False
+
+    async def write(self, data: bytes) -> None:
+        if await self._fuzz():
+            self.dropped_writes += 1
+            return  # silently dropped (fuzz.go Write)
+        await self.conn.write(data)
+
+    async def read(self) -> bytes:
+        while await self._fuzz():
+            self.dropped_reads += 1
+            await self.conn.read()  # consume and discard (fuzz.go Read)
+        return await self.conn.read()
+
+    async def read_exactly(self, n: int) -> bytes:
+        return await self.conn.read_exactly(n)
+
+    async def read_msg(self, max_size: int = 10 * 1024 * 1024) -> bytes:
+        return await self.conn.read_msg(max_size)
+
+    async def write_msg(self, framed: bytes) -> None:
+        if await self._fuzz():
+            self.dropped_writes += 1
+            return
+        await self.conn.write_msg(framed)
+
+    def close(self) -> None:
+        if hasattr(self.conn, "close"):
+            self.conn.close()
+
+    @property
+    def remote_pubkey(self):
+        return getattr(self.conn, "remote_pubkey", None)

@@ -1,0 +1,332 @@
+"""PEX (peer exchange) reactor + address book
+(reference p2p/pex/pex_reactor.go:24,84 and p2p/pex/addrbook.go).
+
+Channel 0x00. Wire messages are the reference's proto oneof
+(proto/tendermint/p2p/pex.proto): PexRequest=1, PexAddrs=2{addrs}.
+Each addr: NetAddress{id=1, ip=2, port=3}.
+
+The address book keeps new/old buckets (addresses graduate to "old" after a
+successful connection), persists to JSON, and answers random selections
+biased toward old (proven) addresses — the reference's GetSelection shape
+without its 256-bucket hashing (bucket pressure only matters at
+internet-crawl scale; the eviction policy is preserved).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..libs import protowire as pw
+from .base import ChannelDescriptor, Peer, Reactor
+from .netaddress import NetAddress
+
+logger = logging.getLogger("tmtpu.p2p.pex")
+
+PEX_CHANNEL = 0x00
+REQUEST_INTERVAL = 30.0       # min seconds between requests per peer
+MAX_ADDRS_PER_MSG = 100
+NEW_BUCKET_CAP = 1024
+OLD_BUCKET_CAP = 1024
+
+
+# -- wire --------------------------------------------------------------------
+
+def encode_pex_request() -> bytes:
+    w = pw.Writer()
+    w.message(1, b"")
+    return w.finish()
+
+
+def encode_pex_addrs(addrs: List[NetAddress]) -> bytes:
+    inner = pw.Writer()
+    for a in addrs:
+        aw = pw.Writer()
+        aw.string(1, a.id)
+        aw.string(2, a.host)
+        aw.varint(3, a.port)
+        inner.message(1, aw.finish())
+    w = pw.Writer()
+    w.message(2, inner.finish())
+    return w.finish()
+
+
+def decode_pex_msg(data: bytes):
+    for fn, _wt, v in pw.iter_fields(data):
+        if fn == 1:
+            return "request", None
+        if fn == 2:
+            addrs = []
+            for afn, _awt, av in pw.iter_fields(v):
+                if afn != 1:
+                    continue
+                f = pw.fields_dict(av)
+                try:
+                    addrs.append(NetAddress(
+                        (f.get(1, [b""])[0] or b"").decode(),
+                        (f.get(2, [b""])[0] or b"").decode(),
+                        int(f.get(3, [0])[0] or 0)))
+                except Exception:
+                    continue
+            return "addrs", addrs
+    raise ValueError("empty pex message")
+
+
+# -- address book ------------------------------------------------------------
+
+@dataclass
+class _KnownAddress:
+    addr: NetAddress
+    src_id: str = ""
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket: str = "new"  # new | old
+
+
+class AddrBook:
+    """(p2p/pex/addrbook.go AddrBook)"""
+
+    def __init__(self, file_path: str = "", strict: bool = True):
+        self.file_path = file_path
+        self.strict = strict
+        self._addrs: Dict[str, _KnownAddress] = {}
+        self._our_ids: set = set()
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    def add_our_address(self, node_id: str) -> None:
+        self._our_ids.add(node_id)
+
+    def add_address(self, addr: NetAddress, src_id: str = "") -> bool:
+        """(addrbook.go AddAddress) returns True if newly added."""
+        if addr.id in self._our_ids:
+            return False
+        if self.strict and not _routable(addr):
+            return False
+        known = self._addrs.get(addr.id)
+        if known is not None:
+            return False
+        if sum(1 for k in self._addrs.values() if k.bucket == "new") \
+                >= NEW_BUCKET_CAP:
+            self._evict_new()
+        self._addrs[addr.id] = _KnownAddress(addr, src_id)
+        return True
+
+    def _evict_new(self) -> None:
+        # drop the most-failed never-succeeded address (addrbook eviction)
+        cands = [k for k in self._addrs.values() if k.bucket == "new"]
+        if cands:
+            victim = max(cands, key=lambda k: (k.attempts, -k.last_attempt))
+            del self._addrs[victim.addr.id]
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        k = self._addrs.get(addr.id)
+        if k is not None:
+            k.attempts += 1
+            k.last_attempt = time.time()
+
+    def mark_good(self, node_id: str) -> None:
+        """(addrbook.go MarkGood) graduate to the old bucket."""
+        k = self._addrs.get(node_id)
+        if k is not None:
+            k.attempts = 0
+            k.last_success = time.time()
+            k.bucket = "old"
+
+    def mark_bad(self, node_id: str) -> None:
+        self._addrs.pop(node_id, None)
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+    def has(self, node_id: str) -> bool:
+        return node_id in self._addrs
+
+    def get_selection(self, limit: int = MAX_ADDRS_PER_MSG) -> List[NetAddress]:
+        """Random sample biased toward proven (old-bucket) addresses
+        (addrbook.go GetSelectionWithBias shape)."""
+        old = [k.addr for k in self._addrs.values() if k.bucket == "old"]
+        new = [k.addr for k in self._addrs.values() if k.bucket == "new"]
+        random.shuffle(old)
+        random.shuffle(new)
+        take_old = min(len(old), -(-limit * 2 // 3))  # ceil: bias to old
+        out = old[:take_old] + new[:limit - take_old]
+        return out[:limit]
+
+    def pick_address(self, exclude=()) -> Optional[NetAddress]:
+        """A random dialable address, preferring fewer failed attempts;
+        ``exclude`` filters already-connected/self ids BEFORE pooling (a
+        stable sort over unusable entries must not starve fresh ones)."""
+        cands = sorted((k for k in self._addrs.values()
+                        if k.addr.id not in exclude),
+                       key=lambda k: k.attempts)
+        if not cands:
+            return None
+        pool = cands[:max(1, len(cands) // 2)]
+        return random.choice(pool).addr
+
+    # -- persistence (addrbook.go saveToFile/loadFromFile) -------------------
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        doc = {"addrs": [
+            {"id": k.addr.id, "host": k.addr.host, "port": k.addr.port,
+             "src": k.src_id, "attempts": k.attempts, "bucket": k.bucket,
+             "last_success": k.last_success}
+            for k in self._addrs.values()
+        ]}
+        os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+        tmp = self.file_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.file_path)
+
+    def _load(self) -> None:
+        try:
+            with open(self.file_path) as f:
+                doc = json.load(f)
+            for a in doc.get("addrs", []):
+                k = _KnownAddress(NetAddress(a["id"], a["host"], a["port"]),
+                                  a.get("src", ""), a.get("attempts", 0),
+                                  bucket=a.get("bucket", "new"),
+                                  last_success=a.get("last_success", 0.0))
+                self._addrs[k.addr.id] = k
+        except Exception as e:
+            logger.warning("addrbook load failed: %s", e)
+
+
+def _routable(addr: NetAddress) -> bool:
+    # strict mode refuses obviously-unroutable junk; localhost allowed for
+    # localnets (the reference gates this by addrBookStrict=false in tests)
+    return bool(addr.host) and 0 < addr.port < 65536
+
+
+# -- reactor ------------------------------------------------------------------
+
+class PEXReactor(Reactor):
+    """(pex_reactor.go) requests addresses from peers when below the target
+    outbound count and dials book addresses; serves selections on request."""
+
+    def __init__(self, book: AddrBook, target_outbound: int = 10,
+                 ensure_interval: float = 5.0,
+                 request_interval: float = REQUEST_INTERVAL):
+        super().__init__("PEX")
+        self.book = book
+        self.target_outbound = target_outbound
+        self.ensure_interval = ensure_interval
+        # both the flood defense AND our own outgoing request pacing
+        # (pex_reactor.go ensurePeers + receiveRequest share the interval)
+        self.request_interval = request_interval
+        self._last_request: Dict[str, float] = {}   # inbound, per peer
+        self._last_sent: Dict[str, float] = {}      # outgoing, per peer
+        self._requested: set = set()
+        self._task: Optional[asyncio.Task] = None
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [ChannelDescriptor(PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10,
+                                  recv_message_capacity=64 * 1024)]
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._ensure_peers_routine())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.book.save()
+
+    async def add_peer(self, peer: Peer) -> None:
+        # learn the peer's self-reported listen addr
+        info = getattr(peer, "node_info", None)
+        if info is not None and info.listen_addr:
+            try:
+                hostport = info.listen_addr.split("://", 1)[-1]
+                host, _, port = hostport.rpartition(":")
+                sock = getattr(peer, "socket_addr", None)
+                host = getattr(sock, "host", None) or host
+                self.book.add_address(NetAddress(peer.id, host, int(port)),
+                                      src_id=peer.id)
+            except Exception:
+                pass
+        self.book.mark_good(peer.id)
+
+    async def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        kind, payload = decode_pex_msg(msg_bytes)
+        if kind == "request":
+            now = time.monotonic()
+            # accept at interval/3 (pex_reactor.go receiveRequest): a margin
+            # below peers' send pacing so clock jitter never drops them
+            if peer.id in self._last_request and \
+                    now - self._last_request[peer.id] < self.request_interval / 3:
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, "pex request flood")
+                return
+            self._last_request[peer.id] = now
+            peer.try_send(PEX_CHANNEL,
+                          encode_pex_addrs(self.book.get_selection()))
+        else:  # addrs
+            if peer.id not in self._requested:
+                # unsolicited address dump (pex_reactor.go ReceiveAddrs err)
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, "unsolicited pex addrs")
+                return
+            self._requested.discard(peer.id)
+            for addr in payload[:MAX_ADDRS_PER_MSG]:
+                self.book.add_address(addr, src_id=peer.id)
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self._last_request.pop(peer.id, None)
+        self._requested.discard(peer.id)
+
+    # -- the ensure-peers loop (pex_reactor.go ensurePeersRoutine) ----------
+
+    async def _ensure_peers_routine(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.ensure_interval)
+                await self._ensure_peers()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("pex ensure-peers died")
+
+    async def _ensure_peers(self) -> None:
+        if self.switch is None:
+            return
+        out = sum(1 for p in self.switch.peers.values() if p.outbound)
+        need = self.target_outbound - out
+        if need <= 0:
+            return
+        # ask a random connected peer for more addresses (paced per peer so
+        # we never trip the remote's flood defense)
+        now = time.monotonic()
+        cands = [p for p in self.switch.peers.values()
+                 if now - self._last_sent.get(p.id, -1e9) >= self.request_interval]
+        if cands:
+            p = random.choice(cands)
+            self._last_sent[p.id] = now
+            self._requested.add(p.id)
+            p.try_send(PEX_CHANNEL, encode_pex_request())
+        # dial from the book
+        exclude = set(self.switch.peers) | {self.switch.node_id}
+        for _ in range(need):
+            addr = self.book.pick_address(exclude)
+            if addr is None:
+                break
+            exclude.add(addr.id)
+            self.book.mark_attempt(addr)
+            ok = await self.switch.dial_peer(addr)
+            if ok:
+                self.book.mark_good(addr.id)

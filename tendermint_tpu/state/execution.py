@@ -63,6 +63,8 @@ class NoOpMempool(Mempool):
 
 
 class BlockExecutor:
+    metrics = None  # StateMetrics, wired by the node
+
     def __init__(self, state_store: StateStore, proxy_app_consensus: Client,
                  mempool: Mempool, evidence_pool: EvidencePool,
                  block_store: Optional[BlockStore] = None, event_bus=None):
@@ -95,6 +97,9 @@ class BlockExecutor:
 
     def apply_block(self, state: State, block_id: BlockID, block: Block) -> Tuple[State, int]:
         """Returns (new_state, retain_height)."""
+        import time as _time
+
+        _t0 = _time.perf_counter()
         self.validate_block(state, block)
 
         abci_responses = exec_block_on_proxy_app(
@@ -122,6 +127,9 @@ class BlockExecutor:
         if self.event_bus is not None:
             fire_events(self.event_bus, block, block_id, abci_responses, validator_updates)
 
+        if self.metrics is not None:
+            self.metrics.block_processing_time.observe(
+                _time.perf_counter() - _t0)
         return new_state, retain_height
 
     def _commit(self, state: State, block: Block,

@@ -1,0 +1,75 @@
+"""One-height state rollback (reference state/rollback.go Rollback):
+re-derives the state at height H-1 from the stores so the node re-applies
+block H — the escape hatch for an app-hash divergence after an app bug fix.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .state import State
+from .store import StateStore
+
+
+class RollbackError(Exception):
+    pass
+
+
+def rollback_state(block_store, state_store: StateStore) -> Tuple[int, bytes]:
+    """-> (rolled-back height, app_hash). Mirrors rollback.go semantics,
+    including the early return when only the block store ran ahead."""
+    invalid = state_store.load()
+    if invalid is None:
+        raise RollbackError("no state found")
+    height = block_store.height()
+
+    # state save and block save are not atomic: if only the block store ran
+    # ahead, restart replay reconciles — nothing to roll back
+    if height == invalid.last_block_height + 1:
+        return invalid.last_block_height, invalid.app_hash
+    if height != invalid.last_block_height:
+        raise RollbackError(
+            f"statestore height ({invalid.last_block_height}) is not one "
+            f"below or equal to blockstore height ({height})")
+
+    rollback_height = invalid.last_block_height - 1
+    rollback_block = block_store.load_block_meta(rollback_height)
+    if rollback_block is None:
+        raise RollbackError(f"block at height {rollback_height} not found")
+    latest_block = block_store.load_block_meta(invalid.last_block_height)
+    if latest_block is None:
+        raise RollbackError(
+            f"block at height {invalid.last_block_height} not found")
+
+    prev_last_validators = state_store.load_validators(rollback_height)
+    if prev_last_validators is None:
+        raise RollbackError(f"no validators at height {rollback_height}")
+    prev_params = state_store.load_consensus_params(rollback_height + 1)
+    if prev_params is None:
+        prev_params = invalid.consensus_params
+
+    val_change = invalid.last_height_validators_changed
+    if val_change == invalid.last_block_height + 1:
+        val_change = rollback_height + 1
+    params_change = invalid.last_height_consensus_params_changed
+    if params_change == invalid.last_block_height + 1:
+        params_change = rollback_height + 1
+
+    rolled = State(
+        chain_id=invalid.chain_id,
+        initial_height=invalid.initial_height,
+        version=invalid.version,
+        last_block_height=rollback_block.header.height,
+        last_block_id=rollback_block.block_id,
+        last_block_time_ns=rollback_block.header.time_ns,
+        next_validators=invalid.validators,
+        validators=invalid.last_validators,
+        last_validators=prev_last_validators,
+        last_height_validators_changed=val_change,
+        consensus_params=prev_params,
+        last_height_consensus_params_changed=params_change,
+        last_results_hash=latest_block.header.last_results_hash,
+        app_hash=latest_block.header.app_hash,
+    )
+    state_store.save(rolled)
+    return rolled.last_block_height, rolled.app_hash

@@ -81,3 +81,79 @@ def test_error_reported_not_fatal(server_client):
         client.query(abci.RequestQuery(data=b"k"))
     # connection still usable
     assert client.echo("still-alive") == "still-alive"
+
+
+def test_response_deliver_tx_gogoproto_golden_vector():
+    """ResponseDeliverTx deterministic encoding must match gogoproto bytes
+    exactly — it feeds LastResultsHash (reference types/results.go:22).
+    Vector hand-derived from proto wire rules for
+    {code:5, data:"abc", gas_wanted:100, gas_used:90}: field 1 varint 5,
+    field 2 bytes "abc", field 5 varint 100, field 6 varint 90 (log/info/
+    events/codespace excluded from the deterministic form, results.go
+    deterministicResponseDeliverTx)."""
+    r = abci.ResponseDeliverTx(code=5, data=b"abc", log="nondet", info="x",
+                               gas_wanted=100, gas_used=90)
+    expected = bytes([0x08, 0x05,              # 1: varint 5
+                      0x12, 0x03, 0x61, 0x62, 0x63,  # 2: "abc"
+                      0x28, 0x64,              # 5: varint 100
+                      0x30, 0x5A])             # 6: varint 90
+    assert r.deterministic_encode() == expected
+    # zero-value: empty encoding (gogoproto omits defaults)
+    assert abci.ResponseDeliverTx().deterministic_encode() == b""
+
+
+def test_proto_codec_round_trips():
+    """Request/Response envelopes round-trip bit-exactly through the
+    reference wire format (proto/tendermint/abci/types.proto oneof)."""
+    from tendermint_tpu.abci.proto_codec import (
+        decode_request,
+        decode_response,
+        encode_request,
+        encode_response,
+    )
+    from tendermint_tpu.libs import protowire as pw
+
+    cases = [
+        ("info", abci.RequestInfo(version="0.34.24", block_version=11,
+                                  p2p_version=8)),
+        ("check_tx", abci.RequestCheckTx(tx=b"k=v",
+                                         type=abci.CHECK_TX_TYPE_RECHECK)),
+        ("deliver_tx", abci.RequestDeliverTx(tx=b"\x00\xffdata")),
+        ("query", abci.RequestQuery(data=b"key", path="/store", height=7,
+                                    prove=True)),
+        ("end_block", abci.RequestEndBlock(height=42)),
+        ("offer_snapshot", abci.RequestOfferSnapshot(
+            snapshot=abci.Snapshot(10, 1, 3, b"h" * 32, b"meta"),
+            app_hash=b"a" * 32)),
+        ("load_snapshot_chunk", abci.RequestLoadSnapshotChunk(10, 1, 2)),
+        ("apply_snapshot_chunk", abci.RequestApplySnapshotChunk(
+            index=1, chunk=b"chunk", sender="peer1")),
+    ]
+    for method, req in cases:
+        framed = encode_request(method, req)
+        ln, pos = pw.decode_varint(framed, 0)
+        m2, req2 = decode_request(framed[pos:pos + ln])
+        assert m2 == method
+        assert req2 == req, (method, req2, req)
+
+    resp_cases = [
+        ("info", abci.ResponseInfo(data="app", version="1", app_version=2,
+                                   last_block_height=5,
+                                   last_block_app_hash=b"\x01" * 8)),
+        ("check_tx", abci.ResponseCheckTx(code=1, log="bad", gas_wanted=3,
+                                          priority=9, sender="s")),
+        ("deliver_tx", abci.ResponseDeliverTx(code=0, data=b"out",
+                                              gas_used=12)),
+        ("commit", abci.ResponseCommit(data=b"apphash", retain_height=3)),
+        ("offer_snapshot", abci.ResponseOfferSnapshot(
+            result=abci.OFFER_SNAPSHOT_ACCEPT)),
+        ("apply_snapshot_chunk", abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_SNAPSHOT_CHUNK_RETRY, refetch_chunks=[1, 2],
+            reject_senders=["bad"])),
+    ]
+    for method, resp in resp_cases:
+        framed = encode_response(method, resp)
+        ln, pos = pw.decode_varint(framed, 0)
+        m2, resp2 = decode_response(framed[pos:pos + ln])
+        assert m2 == method
+        assert resp2 == resp, (method, resp2, resp)

@@ -223,3 +223,29 @@ def test_light_client_against_live_node(tmp_path):
             await node.stop()
 
     asyncio.run(run())
+
+
+def test_verify_chain_batched_parity():
+    """verify_chain_batched must make the same accept/reject decisions as
+    stepwise verify(), with all signatures in one batch."""
+    from tendermint_tpu.light.verifier import verify_chain_batched
+
+    keys = _keys(0x80, 4)
+    blocks = _mk_chain([keys], 8)
+    now = T0 + 100 * 1_000_000_000
+    chain = [blocks[h] for h in range(2, 9)]
+
+    # happy path
+    verify_chain_batched(blocks[1], chain, 3600.0, now, 10.0)
+
+    # corrupt one signature mid-chain: same error as the stepwise path
+    import copy
+    bad_chain = copy.deepcopy(chain)
+    sigs = bad_chain[3].signed_header.commit.signatures
+    sigs[0].signature = b"\x00" * 64
+    with pytest.raises(ErrInvalidHeader):
+        verify_chain_batched(blocks[1], bad_chain, 3600.0, now, 10.0)
+
+    # expired trust fails identically
+    with pytest.raises(ErrOldHeaderExpired):
+        verify_chain_batched(blocks[1], chain, 1.0, now, 10.0)

@@ -223,3 +223,24 @@ def test_four_validator_consensus_over_tcp():
         hashes = {nd.block_store.load_block_meta(2).header.hash() for nd in nodes}
         assert len(hashes) == 1
     asyncio.run(run())
+
+
+def test_fuzzed_connection_drops_and_passes():
+    """FuzzedConnection (reference p2p/fuzz.go): probabilistic write drops;
+    prob 0 passes everything, prob 1 drops everything silently."""
+    from tendermint_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+
+    async def run():
+        _k1, _k2, sc1, sc2, server = await _spawn_pair()()
+        # prob 0: transparent
+        f0 = FuzzedConnection(sc1, FuzzConnConfig(prob_drop_rw=0.0, seed=1))
+        await f0.write(b"pass")
+        assert await sc2.read() == b"pass"
+        # prob 1: every write silently dropped
+        f1 = FuzzedConnection(sc1, FuzzConnConfig(prob_drop_rw=1.0, seed=1))
+        await f1.write(b"dropped")
+        assert f1.dropped_writes == 1
+        await f0.write(b"after")   # the transport itself is still healthy
+        assert await sc2.read() == b"after"
+        server.close()
+    asyncio.run(run())
