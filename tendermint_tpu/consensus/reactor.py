@@ -295,6 +295,7 @@ class ConsensusReactor(Reactor):
         cs.new_round_step_listeners.append(self._broadcast_new_round_step)
         cs.valid_block_listeners.append(self._broadcast_new_valid_block)
         cs.vote_listeners.append(self._broadcast_has_vote)
+        cs.equivocation_listeners.append(self._broadcast_vote_directly)
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [
@@ -348,6 +349,12 @@ class ConsensusReactor(Reactor):
             self._start_task = asyncio.create_task(self.cs.start())
 
     # -- inbound -----------------------------------------------------------
+
+    def _broadcast_vote_directly(self, vote) -> None:
+        """Maverick support: push a (possibly equivocating) vote to every
+        peer on the vote channel, bypassing vote-set gossip."""
+        if self.switch is not None:
+            self.switch.broadcast(VOTE_CHANNEL, encode_msg(VoteMessageWire(vote)))
 
     async def _preverify_and_forward(self, vote, peer_id: str) -> None:
         """Pre-verify then enqueue to the state machine. Vote delivery order

@@ -100,6 +100,9 @@ class ConsensusState:
         self.new_round_step_listeners: List[Callable[[RoundState], None]] = []
         self.valid_block_listeners: List[Callable[[RoundState], None]] = []
         self.vote_listeners: List[Callable[[Vote], None]] = []
+        # maverick hook: votes pushed STRAIGHT to peers, bypassing our own
+        # VoteSet (which rightly rejects equivocations)
+        self.equivocation_listeners: List[Callable[[Vote], None]] = []
 
         # HOT LOOP #1 seam: gossiped-vote signature checks go through a
         # micro-batching verifier (crypto/vote_batcher.py). The reactor
@@ -108,6 +111,11 @@ class ConsensusState:
         from ..crypto.vote_batcher import BatchVoteVerifier
         self.vote_verifier = BatchVoteVerifier()
         self.metrics = None  # ConsensusMetrics, wired by the node
+        # byzantine test hooks (the reference's maverick node,
+        # test/maverick/consensus/misbehavior.go): height -> behavior name.
+        # Supported: "double-prevote" (equivocate at prevote). Only MockPV
+        # signers cooperate — FilePV's double-sign protection refuses.
+        self.misbehaviors: dict = {}
 
         self._queue: "asyncio.Queue" = asyncio.Queue(maxsize=1000)
         self._timeout_task: Optional[asyncio.Task] = None
@@ -524,8 +532,27 @@ class ConsensusState:
         self._new_step()
 
     def _do_prevote(self, height: int, round_: int) -> None:
-        """(state.go:1252 defaultDoPrevote)"""
+        """(state.go:1252 defaultDoPrevote; maverick hook at the top —
+        misbehavior.go PrevoteForBlockAndNil)"""
         rs = self.rs
+        if self.misbehaviors.get(height) == "double-prevote" \
+                and rs.proposal_block is not None \
+                and self.priv_validator is not None:
+            logger.warning("MISBEHAVIOR double-prevote at height %d", height)
+            self._sign_add_vote(SignedMsgType.PREVOTE, rs.proposal_block.hash(),
+                                rs.proposal_block_parts.header())
+            try:
+                # equivocate: a second, conflicting nil prevote straight to
+                # the reactors (our own VoteSet would reject it; peers must
+                # see it). A refusing signer (FilePV) must not abort the
+                # step transition — misbehaving is best-effort.
+                nil_vote = self._sign_vote(SignedMsgType.PREVOTE, b"",
+                                           PartSetHeader())
+                for listener in self.equivocation_listeners:
+                    listener(nil_vote)
+            except Exception as e:
+                logger.error("double-prevote equivocation refused: %s", e)
+            return
         if rs.locked_block is not None:
             self._sign_add_vote(SignedMsgType.PREVOTE, rs.locked_block.hash(),
                                 rs.locked_block_parts.header())
@@ -719,6 +746,9 @@ class ConsensusState:
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
 
+        from ..libs.fail import fail_point
+
+        fail_point()  # (consensus/state.go:776 fail.Fail precommit->commit)
         # EndHeight implies blockstore has the block (crash recovery pivot).
         self.wal.write_end_height(height, now_ns())
 

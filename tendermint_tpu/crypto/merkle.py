@@ -70,6 +70,37 @@ class Proof:
             return False
         return self.compute_root() == root
 
+    def encode(self) -> bytes:
+        """(proto tendermint.crypto.Proof: total=1 index=2 leaf_hash=3
+        aunts=4)"""
+        from ..libs import protowire as pw
+
+        w = pw.Writer()
+        w.varint(1, self.total)
+        if self.index:
+            w.varint(2, self.index)
+        w.bytes(3, self.leaf_hash)
+        for a in self.aunts:
+            w.bytes(4, a)
+        return w.finish()
+
+    @staticmethod
+    def decode(data: bytes) -> "Proof":
+        from ..libs import protowire as pw
+
+        f = pw.fields_dict(data)
+
+        def as_int(v) -> int:
+            if not isinstance(v, int):
+                raise ValueError("expected varint field in Proof")
+            return pw.varint_to_int64(v)
+
+        return Proof(
+            total=as_int(f.get(1, [0])[0] or 0),
+            index=as_int(f.get(2, [0])[0] or 0),
+            leaf_hash=pw.as_bytes(f.get(3, [b""])[0] or b""),
+            aunts=[pw.as_bytes(a) for a in f.get(4, [])])
+
 
 def _root_from_aunts(index: int, total: int, lh: bytes, aunts: List[bytes]) -> Optional[bytes]:
     if total == 0:
@@ -133,3 +164,141 @@ def _trails_from_byte_slices(items: List[bytes]):
     right_root.parent = root
     right_root.sibling = left_root
     return lefts + rights, root
+
+
+# --- ProofOp chains (reference crypto/merkle/proof_op.go) -------------------
+#
+# Chained merkle proofs across trees (app store proofs through the light
+# proxy): each operator maps leaf value(s) to its tree's root; the last root
+# must equal the trusted one; keys are consumed right-to-left against the
+# URL-encoded keypath (proof_key_path.go).
+
+from urllib.parse import quote as _quote, unquote_to_bytes as _unquote
+
+
+def _encode_byte_slice(b: bytes) -> bytes:
+    """(libs/protoio encodeByteSlice) uvarint length prefix + bytes — the
+    leaf encoding proof_value.go uses for both key and value hash."""
+    from ..libs import protowire as pw
+
+    return pw.encode_varint(len(b)) + b
+
+
+@dataclass
+class ProofOp:
+    """(proto tendermint.crypto.ProofOp) the generic encoded operator."""
+
+    type: str = ""
+    key: bytes = b""
+    data: bytes = b""
+
+
+class ProofOperator:
+    """(proof_op.go ProofOperator)"""
+
+    def run(self, args: List[bytes]) -> List[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+    def proof_op(self) -> ProofOp:
+        raise NotImplementedError
+
+
+class ValueOp(ProofOperator):
+    """(proof_value.go) leaf = leafHash(encodeByteSlice(key) ||
+    encodeByteSlice(sha256(value))) proven into a simple tree — the exact
+    reference leaf encoding, so proofs interoperate with reference apps."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self.key = key
+        self.proof = proof
+
+    def run(self, args: List[bytes]) -> List[bytes]:
+        if len(args) != 1:
+            raise ValueError(f"expected 1 arg, got {len(args)}")
+        vhash = _sha256(args[0])
+        leaf = leaf_hash(_encode_byte_slice(self.key)
+                         + _encode_byte_slice(vhash))
+        if leaf != self.proof.leaf_hash:
+            raise ValueError("leaf mismatch in ValueOp")
+        root = self.proof.compute_root()
+        if root is None:
+            raise ValueError("bad proof in ValueOp")
+        return [root]
+
+    def get_key(self) -> bytes:
+        return self.key
+
+    def proof_op(self) -> ProofOp:
+        return ProofOp(self.TYPE, self.key, self.proof.encode())
+
+
+def key_path(*keys: bytes) -> str:
+    """(proof_key_path.go KeyPath) '/' + url-encoded key components."""
+    return "".join("/" + _quote(k, safe="") for k in keys)
+
+
+def keypath_to_keys(path: str) -> List[bytes]:
+    if not path.startswith("/"):
+        raise ValueError(f"keypath must start with '/': {path!r}")
+    return [_unquote(p) for p in path[1:].split("/") if p]
+
+
+class ProofRuntime:
+    """(proof_op.go ProofRuntime) decoder registry + chained verification."""
+
+    def __init__(self):
+        self._decoders: _Dict[str, _Callable[[ProofOp], ProofOperator]] = {}
+
+    def register_op_decoder(self, type_: str, dec) -> None:
+        if type_ in self._decoders:
+            raise ValueError(f"already registered for type {type_}")
+        self._decoders[type_] = dec
+
+    def decode(self, op: ProofOp) -> ProofOperator:
+        dec = self._decoders.get(op.type)
+        if dec is None:
+            raise ValueError(f"unrecognized proof op type {op.type!r}")
+        return dec(op)
+
+    def verify_value(self, ops: List[ProofOp], root: bytes, keypath: str,
+                     value: bytes) -> None:
+        self.verify(ops, root, keypath, [value])
+
+    def verify(self, ops: List[ProofOp], root: bytes, keypath: str,
+               args: List[bytes]) -> None:
+        """(proof_op.go ProofOperators.Verify) run the chain; keys consumed
+        right-to-left; final root must match."""
+        keys = keypath_to_keys(keypath)
+        operators = [self.decode(op) for op in ops]
+        for i, op in enumerate(operators):
+            key = op.get_key()
+            if key:
+                if not keys:
+                    raise ValueError(
+                        f"key path has insufficient parts: got {key!r}")
+                if keys[-1] != key:
+                    raise ValueError(
+                        f"key mismatch on operation #{i}: expected "
+                        f"{keys[-1]!r} but got {key!r}")
+                keys = keys[:-1]
+            args = op.run(args)
+        if root != args[0]:
+            raise ValueError(
+                f"calculated root hash is invalid: expected {root.hex()} "
+                f"but got {args[0].hex()}")
+        if keys:
+            raise ValueError("keypath not consumed all")
+
+
+def default_proof_runtime() -> ProofRuntime:
+    """(proof_op.go DefaultProofRuntime) with the simple-value decoder."""
+    prt = ProofRuntime()
+    prt.register_op_decoder(
+        ValueOp.TYPE,
+        lambda op: ValueOp(op.key, Proof.decode(op.data)))
+    return prt

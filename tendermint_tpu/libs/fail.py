@@ -1,0 +1,36 @@
+"""Crash-point injection (reference libs/fail/fail.go): the commit path is
+sprinkled with ``fail_point()`` calls; setting ``TMTPU_FAIL_INDEX=N`` kills
+the process at the Nth point reached, so crash-consistency tests can murder
+a node at every interesting boundary (reference sites:
+state/execution.go:149,156,188,196, consensus/state.go:776).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_counter = 0
+
+
+def fail_index() -> int:
+    v = os.environ.get("TMTPU_FAIL_INDEX")
+    return int(v) if v else -1
+
+
+def fail_point() -> None:
+    """(fail.go Fail) exit(1) when the configured index is reached."""
+    global _counter
+    idx = fail_index()
+    if idx < 0:
+        return
+    if _counter == idx:
+        sys.stderr.write(f"*** fail point {idx} reached: exiting ***\n")
+        sys.stderr.flush()
+        os._exit(1)
+    _counter += 1
+
+
+def reset() -> None:
+    global _counter
+    _counter = 0

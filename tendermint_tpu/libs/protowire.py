@@ -135,6 +135,15 @@ def varint_to_int64(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+def as_bytes(v) -> bytes:
+    """Guard for nested-message fields: a peer can send any wire type for
+    any field number, so decoders must reject varints where they expect
+    sub-messages with a clean ValueError (fuzz finding)."""
+    if not isinstance(v, (bytes, bytearray)):
+        raise ValueError(f"expected length-delimited field, got {type(v).__name__}")
+    return bytes(v)
+
+
 def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
     """Yield (field_num, wire_type, value). value: int for varint/fixed, bytes for len-delimited."""
     pos = 0

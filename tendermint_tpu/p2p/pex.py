@@ -63,10 +63,10 @@ def decode_pex_msg(data: bytes):
             return "request", None
         if fn == 2:
             addrs = []
-            for afn, _awt, av in pw.iter_fields(v):
+            for afn, _awt, av in pw.iter_fields(pw.as_bytes(v)):
                 if afn != 1:
                     continue
-                f = pw.fields_dict(av)
+                f = pw.fields_dict(pw.as_bytes(av))
                 try:
                     addrs.append(NetAddress(
                         (f.get(1, [b""])[0] or b"").decode(),

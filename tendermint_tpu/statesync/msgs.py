@@ -73,7 +73,7 @@ def encode_msg(msg) -> bytes:
 
 def decode_msg(data: bytes):
     for fn, _wt, v in pw.iter_fields(data):
-        f = pw.fields_dict(v)
+        f = pw.fields_dict(pw.as_bytes(v)) if fn != 1 else {}
         if fn == 1:
             return SnapshotsRequest()
         if fn == 2:

@@ -241,3 +241,49 @@ def test_vote_path_takes_device_batches():
     assert verifier.stats["cache_hits"] == n, dict(verifier.stats)
     assert verifier.stats["sync_host_sigs"] == 0, dict(verifier.stats)
     assert vote_set.has_two_thirds_majority()
+
+
+def test_byzantine_double_prevote_produces_evidence():
+    """Maverick-style byzantine hook (reference test/maverick/consensus/
+    misbehavior.go double-prevote): one validator equivocates at height 2;
+    honest nodes detect the conflicting votes, pool DuplicateVoteEvidence,
+    and commit it in a block — while the chain keeps making progress."""
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+    async def run():
+        nodes = make_net(4)
+        nodes[0].cs.misbehaviors[2] = "double-prevote"
+        # evidence needs real pools: swap EmptyEvidencePool for real ones
+        from tendermint_tpu.evidence.pool import EvidencePool
+        from tendermint_tpu.libs.db import MemDB
+
+        for nd in nodes:
+            pool = EvidencePool(MemDB(), nd.state_store, nd.block_store)
+            nd.cs.evpool = pool
+            nd.block_exec.evpool = pool
+            nd.evidence_pool = pool
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 5, timeout=60.0)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        # at least one honest node pooled duplicate-vote evidence, and some
+        # block in 2..5 carries it on every node that committed it
+        found_in_block = False
+        byz_addr = nodes[0].pv.get_pub_key().address()
+        for nd in nodes[1:]:
+            for h in range(2, nd.block_store.height() + 1):
+                blk = nd.block_store.load_block(h)
+                for ev in (blk.evidence if blk else []):
+                    if isinstance(ev, DuplicateVoteEvidence):
+                        assert ev.vote_a.validator_address == byz_addr
+                        found_in_block = True
+        assert found_in_block, "duplicate-vote evidence never committed"
+
+    asyncio.run(run())

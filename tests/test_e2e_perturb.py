@@ -1,0 +1,109 @@
+"""Multi-process e2e perturbations (reference test/e2e/runner/perturb.go:28-66
+kill/pause/restart + post-run invariant checks over RPC): a CLI-generated
+localnet survives a SIGKILL'd validator, keeps making progress on 3/4 power,
+and the restarted node catches back up; app hashes agree across all nodes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 28800
+
+
+def _rpc(i, path):
+    url = f"http://127.0.0.1:{BASE_PORT + 2 * i + 1}/{path}"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.load(r)["result"]
+
+
+def _heights(n):
+    out = []
+    for i in range(n):
+        try:
+            out.append(int(_rpc(i, "status")["sync_info"]["latest_block_height"]))
+        except Exception:
+            out.append(-1)
+    return out
+
+
+def _spawn(env, out, i):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd",
+         "--home", os.path.join(out, f"node{i}"),
+         "start", "--log-level", "warning"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+
+@pytest.mark.slow
+def test_kill_and_restart_validator(tmp_path):
+    out = str(tmp_path / "tnet")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "testnet", "--v", "4",
+         "--output-dir", out, "--chain-id", "perturb-e2e",
+         "--starting-port", str(BASE_PORT)],
+        check=True, env=env, cwd=REPO, capture_output=True, timeout=120)
+
+    procs = {i: _spawn(env, out, i) for i in range(4)}
+    try:
+        # phase 1: all four make progress
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            hs = _heights(4)
+            if min(hs) >= 2:
+                break
+            time.sleep(1)
+        assert min(_heights(4)) >= 2, f"no initial progress: {_heights(4)}"
+
+        # perturbation: SIGKILL node 3 (perturb.go "kill")
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        h_at_kill = max(_heights(3))
+
+        # liveness on 3/4 voting power
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            hs = _heights(3)
+            if min(hs) >= h_at_kill + 3:
+                break
+            time.sleep(1)
+        assert min(_heights(3)) >= h_at_kill + 3, \
+            f"net stalled after kill: {_heights(3)}"
+
+        # restart: the node recovers via WAL/handshake replay and catches up
+        procs[3] = _spawn(env, out, 3)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            hs = _heights(4)
+            if hs[3] >= h_at_kill + 3:
+                break
+            time.sleep(1)
+        assert _heights(4)[3] >= h_at_kill + 3, \
+            f"restarted node did not catch up: {_heights(4)}"
+
+        # invariant: app-hash agreement at a common height (test/e2e/tests)
+        common = min(_heights(4)) - 1
+        hashes = {_rpc(i, f"commit?height={common}")["signed_header"]
+                  ["header"]["app_hash"] for i in range(4)}
+        assert len(hashes) == 1, hashes
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

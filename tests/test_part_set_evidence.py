@@ -98,3 +98,51 @@ def test_duplicate_vote_evidence_roundtrip_and_hash():
 def test_dve_vote_ordering_by_block_key():
     ev, _ = _mk_dve()
     assert ev.vote_a.block_id.key() < ev.vote_b.block_id.key()
+
+
+def test_proof_op_chain_verification():
+    """ProofOp chains (reference crypto/merkle/proof_op.go + proof_value.go):
+    an app-store value proven through chained merkle trees, verified via the
+    ProofRuntime against the outer root and the URL-encoded key path."""
+    from tendermint_tpu.crypto.merkle import (
+        Proof,
+        ProofOp,
+        ValueOp,
+        default_proof_runtime,
+        key_path,
+        leaf_hash,
+        proofs_from_byte_slices,
+        hash_from_byte_slices,
+    )
+    import hashlib
+
+    from tendermint_tpu.crypto.merkle import _encode_byte_slice
+
+    # inner "store" tree leaves: encodeByteSlice(key)||encodeByteSlice(vhash)
+    # (proof_value.go — length-prefixed, reference-compatible)
+    items = []
+    kvs = [(b"alpha", b"1"), (b"beta", b"2"), (b"gamma/3", b"3")]
+    for k, v in kvs:
+        items.append(_encode_byte_slice(k)
+                     + _encode_byte_slice(hashlib.sha256(v).digest()))
+    root = hash_from_byte_slices(items)
+    proofs = proofs_from_byte_slices(items)
+
+    prt = default_proof_runtime()
+    key, value = kvs[1]
+    op = ValueOp(key, proofs[1])
+    # happy path
+    prt.verify_value([op.proof_op()], root, key_path(key), value)
+    # wrong value fails
+    with pytest.raises(ValueError):
+        prt.verify_value([op.proof_op()], root, key_path(key), b"99")
+    # wrong key path fails
+    with pytest.raises(ValueError):
+        prt.verify_value([op.proof_op()], root, key_path(b"alpha"), value)
+    # wrong root fails
+    with pytest.raises(ValueError):
+        prt.verify_value([op.proof_op()], b"\x00" * 32, key_path(key), value)
+    # keypath with special chars round-trips the URL encoding
+    k3, v3 = kvs[2]
+    op3 = ValueOp(k3, proofs[2])
+    prt.verify_value([op3.proof_op()], root, key_path(k3), v3)
