@@ -234,6 +234,47 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """(cmd/tendermint/commands/light.go) verifying light proxy."""
+    from .light.client import LightClient, TrustOptions
+    from .light.provider import HTTPProvider
+    from .light.proxy import LightProxy
+    from .rpc.client import HTTPClient
+
+    async def run():
+        primary = HTTPClient(args.primary)
+        provider = HTTPProvider(args.chain_id, primary)
+        witnesses = [HTTPProvider(args.chain_id, HTTPClient(w))
+                     for w in (args.witnesses.split(",") if args.witnesses
+                               else [])]
+        lc = LightClient(
+            args.chain_id,
+            TrustOptions(args.trust_period, args.trust_height,
+                         bytes.fromhex(args.trust_hash)),
+            provider, witnesses)
+        from .node import _parse_laddr
+
+        proxy = LightProxy(lc, primary)
+        host, port = _parse_laddr(args.laddr)
+        bound = await proxy.start(host, port)
+        print(f"light proxy for {args.chain_id} on port {bound} "
+              f"(primary {args.primary})")
+        stop = asyncio.Event()
+        try:
+            import signal
+
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+        await stop.wait()
+        await proxy.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -264,6 +305,18 @@ def main(argv=None) -> int:
     sp.add_argument("--starting-port", dest="starting_port", type=int,
                     default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("light", help="verifying light-client proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True)
+    sp.add_argument("--witnesses", default="")
+    sp.add_argument("--trust-height", dest="trust_height", type=int,
+                    required=True)
+    sp.add_argument("--trust-hash", dest="trust_hash", required=True)
+    sp.add_argument("--trust-period", dest="trust_period", type=float,
+                    default=168 * 3600.0)
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.set_defaults(fn=cmd_light)
 
     for name, fn in [("rollback", cmd_rollback),
                      ("gen-node-key", cmd_gen_node_key),

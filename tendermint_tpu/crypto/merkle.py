@@ -252,7 +252,7 @@ class ProofRuntime:
     """(proof_op.go ProofRuntime) decoder registry + chained verification."""
 
     def __init__(self):
-        self._decoders: _Dict[str, _Callable[[ProofOp], ProofOperator]] = {}
+        self._decoders = {}  # type name -> ProofOp decoder
 
     def register_op_decoder(self, type_: str, dec) -> None:
         if type_ in self._decoders:

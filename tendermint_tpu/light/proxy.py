@@ -1,0 +1,150 @@
+"""Light proxy: a local JSON-RPC server that forwards to a full node and
+VERIFIES everything verifiable against light-client state before answering
+(reference light/proxy/proxy.go, light/rpc/client.go — the `light` CLI).
+
+Verified routes: ``commit``, ``block``, ``validators`` (checked against a
+light-client-verified header: header hash, data hash, validator hashes).
+Forwarded as-is (unverifiable without app proofs): ``status``, ``health``,
+``genesis``, ``abci_query`` (proof-op verification plugs in through
+crypto/merkle.ProofRuntime once the app serves proofs), broadcast routes.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from ..rpc.core import RPCError
+from ..rpc.server import _rpc_response
+from .client import LightClient
+from .provider import _decode_signed_header, _decode_validators
+
+logger = logging.getLogger("tmtpu.light.proxy")
+
+FORWARD_ROUTES = [
+    "health", "status", "genesis", "net_info", "abci_info", "abci_query",
+    "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
+    "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
+]
+VERIFIED_ROUTES = ["commit", "block", "validators"]
+
+
+class LightProxy:
+    def __init__(self, client: LightClient, primary_rpc):
+        self.lc = client
+        self.rpc = primary_rpc  # rpc.client.HTTPClient to the primary
+        self._runner: Optional[web.AppRunner] = None
+        self.bound_port: Optional[int] = None
+
+    # -- verified handlers ---------------------------------------------------
+
+    async def _verified_block(self, height: int) -> Dict[str, Any]:
+        doc = await self.rpc.block(height or None)
+        h = int(doc["block"]["header"]["height"])
+        lb = await self.lc.verify_light_block_at_height(h)
+        got = _decode_signed_header(
+            {"header": doc["block"]["header"],
+             "commit": doc["block"]["last_commit"] or
+             {"height": 0, "round": 0,
+              "block_id": {"hash": "", "parts": {"total": 0, "hash": ""}},
+              "signatures": []}})
+        if got.header.hash() != lb.signed_header.header.hash():
+            raise RPCError(-32603, "primary served a block whose header does "
+                                   "not match the verified header")
+        # data integrity: txs must hash to the verified header's data_hash
+        from ..types.block import Data
+
+        txs = [base64.b64decode(t) for t in doc["block"]["data"]["txs"]]
+        if Data(txs=txs).hash() != lb.signed_header.header.data_hash:
+            raise RPCError(-32603, "block data does not match verified "
+                                   "data_hash")
+        return doc
+
+    async def _verified_commit(self, height: int) -> Dict[str, Any]:
+        doc = await self.rpc.commit(height or None)
+        sh = _decode_signed_header(doc["signed_header"])
+        lb = await self.lc.verify_light_block_at_height(sh.header.height)
+        if sh.header.hash() != lb.signed_header.header.hash():
+            raise RPCError(-32603, "primary served a commit for an "
+                                   "unverified header")
+        return doc
+
+    async def _verified_validators(self, height: int) -> Dict[str, Any]:
+        doc = await self.rpc.validators(height or None, per_page=100)
+        h = int(doc["block_height"])
+        lb = await self.lc.verify_light_block_at_height(h)
+        vals = _decode_validators(doc["validators"])
+        # page through the full set (the server caps per_page at 100)
+        total = int(doc["total"])
+        page = 2
+        while len(vals) < total:
+            more = await self.rpc.validators(h, page=page, per_page=100)
+            got = _decode_validators(more["validators"])
+            if not got:
+                break
+            vals.extend(got)
+            page += 1
+        from ..types.validator_set import ValidatorSet
+
+        if ValidatorSet(vals).hash() != lb.signed_header.header.validators_hash:
+            raise RPCError(-32603, "primary served validators that do not "
+                                   "hash to the verified header")
+        return doc
+
+    # -- server --------------------------------------------------------------
+
+    async def _dispatch(self, method: str, params: Dict[str, Any]):
+        height = int(params.get("height") or 0)
+        if method == "commit":
+            return await self._verified_commit(height)
+        if method == "block":
+            return await self._verified_block(height)
+        if method == "validators":
+            return await self._verified_validators(height)
+        if method in FORWARD_ROUTES:
+            return await self.rpc.call(method, **params)
+        raise RPCError(-32601, f"method {method!r} not supported by the "
+                               "light proxy")
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                _rpc_response(None, error=RPCError(-32700, "parse error")))
+        if not isinstance(body, dict):
+            # batches are not proxied (each entry would need verification
+            # context); answer with a structured error, not a 500
+            return web.json_response(_rpc_response(
+                None, error=RPCError(-32600,
+                                     "light proxy accepts single requests only")))
+        method = body.get("method", "")
+        params = body.get("params") or {}
+        try:
+            result = await self._dispatch(method, params)
+            return web.json_response(_rpc_response(body.get("id"), result))
+        except RPCError as e:
+            return web.json_response(_rpc_response(body.get("id"), error=e))
+        except Exception as e:
+            logger.exception("light proxy %s failed", method)
+            return web.json_response(_rpc_response(
+                body.get("id"), error=RPCError(-32603, str(e))))
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        app = web.Application()
+        app.router.add_post("/", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.bound_port = (self._runner.addresses[0][1]
+                           if self._runner.addresses else port)
+        logger.info("light proxy on %s:%d", host, self.bound_port)
+        return self.bound_port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

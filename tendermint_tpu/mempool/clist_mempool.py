@@ -71,6 +71,7 @@ class CListMempool:
                  recheck: bool = True):
         self._proxy_app = proxy_app
         self.metrics = None  # MempoolMetrics, wired by the node
+        self._wal = None  # optional tx log (mempool/v0 WAL, mempool.go InitWAL)
         self._height = height
         self._max_txs = max_txs
         self._max_txs_bytes = max_txs_bytes
@@ -143,6 +144,8 @@ class CListMempool:
                                    {sender} if sender else set(), key)
                 self._txs[key] = mem_tx
                 self._txs_bytes += len(tx)
+                if self._wal is not None:
+                    self._wal.write(tx)
                 if self.metrics is not None:
                     self.metrics.size.set(len(self._txs))
                 self._notify_txs_available()
@@ -249,3 +252,30 @@ def _proto_overhead(n: int) -> int:
     from ..types.tx import compute_proto_size_overhead
 
     return compute_proto_size_overhead(n)
+
+
+class MempoolWAL:
+    """Append-only tx log (reference mempool WAL, clist_mempool.go InitWAL):
+    newline-delimited hex, flushed per write — a recovery/debugging trail of
+    every tx that entered the mempool."""
+
+    def __init__(self, wal_dir: str):
+        import os
+
+        os.makedirs(wal_dir, exist_ok=True)
+        self._f = open(os.path.join(wal_dir, "wal"), "ab")
+
+    def write(self, tx: bytes) -> None:
+        self._f.write(tx.hex().encode() + b"\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except ValueError:
+            pass
+
+
+def init_mempool_wal(mempool, wal_dir: str) -> None:
+    """(mempool.go InitWAL)"""
+    mempool._wal = MempoolWAL(wal_dir)

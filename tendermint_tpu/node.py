@@ -100,6 +100,10 @@ class Node:
         # -- mempool (node.go:368) ------------------------------------------
         self.mempool = CListMempool(self.proxy_app.mempool,
                                     height=state.last_block_height)
+        if config.mempool.wal_dir:
+            from .mempool.clist_mempool import init_mempool_wal
+
+            init_mempool_wal(self.mempool, config._rootify(config.mempool.wal_dir))
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=config.mempool.broadcast)
 
@@ -362,6 +366,9 @@ class Node:
         runner = getattr(self, "_metrics_runner", None)
         if runner is not None:
             await runner.cleanup()
+        wal = getattr(self.mempool, "_wal", None)
+        if wal is not None:
+            wal.close()
         self.proxy_app.stop()
 
 

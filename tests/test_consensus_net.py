@@ -269,12 +269,13 @@ def test_byzantine_double_prevote_produces_evidence():
             await nd.start()
         await net.connect_all()
         try:
-            await wait_all_height(nodes, 5, timeout=60.0)
+            # enough heights for gossip to surface the conflict and for the
+            # next proposer to include the pooled evidence (timing varies)
+            await wait_all_height(nodes, 8, timeout=90.0)
         finally:
             for nd in nodes:
                 await nd.stop()
-        # at least one honest node pooled duplicate-vote evidence, and some
-        # block in 2..5 carries it on every node that committed it
+        # some honest node committed the duplicate-vote evidence in a block
         found_in_block = False
         byz_addr = nodes[0].pv.get_pub_key().address()
         for nd in nodes[1:]:

@@ -249,3 +249,71 @@ def test_verify_chain_batched_parity():
     # expired trust fails identically
     with pytest.raises(ErrOldHeaderExpired):
         verify_chain_batched(blocks[1], chain, 1.0, now, 10.0)
+
+
+def test_light_proxy_verifies_primary(tmp_path):
+    """Light proxy (reference light/proxy): commit/block/validators answers
+    are verified against light-client state; a lying primary is rejected."""
+    from tests.test_node_rpc import _mk_node
+    from tendermint_tpu.light.provider import HTTPProvider
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    async def run():
+        node = _mk_node(tmp_path)
+        await node.start()
+        proxy = None
+        try:
+            rpc = HTTPClient(f"http://127.0.0.1:{node.rpc_server.bound_port}")
+            for _ in range(300):
+                st = await rpc.status()
+                if int(st["sync_info"]["latest_block_height"]) >= 4:
+                    break
+                await asyncio.sleep(0.05)
+            provider = HTTPProvider("rpc-chain", rpc)
+            lb1 = await provider.light_block(1)
+            lc = LightClient(
+                "rpc-chain",
+                TrustOptions(10 * 365 * 24 * 3600.0, 1,
+                             lb1.signed_header.header.hash()),
+                provider, [])
+            proxy = LightProxy(lc, rpc)
+            port = await proxy.start()
+
+            client = HTTPClient(f"http://127.0.0.1:{port}")
+            cmt = await client.commit(3)
+            assert cmt["signed_header"]["header"]["height"] == "3"
+            blk = await client.block(3)
+            assert blk["block"]["header"]["height"] == "3"
+            vals = await client.validators(3)
+            assert vals["total"] == "1"
+            st = await client.status()  # forwarded route
+            assert st["node_info"]["network"] == "rpc-chain"
+
+            # a lying primary: tamper with the proxy's forwarded answer by
+            # pointing it at a client that alters block data
+            class LyingClient:
+                def __init__(self, inner):
+                    self.inner = inner
+
+                async def block(self, height=None):
+                    doc = await self.inner.block(height)
+                    doc["block"]["data"]["txs"] = ["bGllcw=="]  # "lies"
+                    return doc
+
+                def __getattr__(self, name):
+                    return getattr(self.inner, name)
+
+            proxy.rpc = LyingClient(rpc)
+            from tendermint_tpu.rpc.core import RPCError as _E
+
+            with pytest.raises(_E):
+                await client.block(3)
+            await client.close()
+            await rpc.close()
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            await node.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""loadtime: tx load generator + latency report
+(reference test/loadtime — txs embed send timestamps; the report tool reads
+them back from committed blocks and prints latency percentiles).
+
+Usage:
+    python tools/loadtime.py load --endpoint http://127.0.0.1:26657 \
+        --rate 50 --duration 10 --size 128
+    python tools/loadtime.py report --endpoint http://127.0.0.1:26657
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import os
+import struct
+import sys
+import time
+import urllib.request
+
+MAGIC = b"ltm1"
+
+
+def make_tx(size: int, seq: int) -> bytes:
+    """MAGIC || send_time_ns (8B) || seq (8B) || padding."""
+    body = MAGIC + struct.pack(">QQ", time.time_ns(), seq)
+    return body + os.urandom(max(0, size - len(body)))
+
+
+def parse_tx(tx: bytes):
+    if not tx.startswith(MAGIC) or len(tx) < 20:
+        return None
+    send_ns, seq = struct.unpack(">QQ", tx[4:20])
+    return send_ns, seq
+
+
+async def load(endpoint: str, rate: float, duration: float, size: int) -> int:
+    import aiohttp
+
+    sent = ok = 0
+    interval = 1.0 / rate if rate > 0 else 0.0
+    deadline = time.monotonic() + duration
+    async with aiohttp.ClientSession() as s:
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            tx = make_tx(size, sent)
+            payload = {"jsonrpc": "2.0", "id": sent,
+                       "method": "broadcast_tx_sync",
+                       "params": {"tx": base64.b64encode(tx).decode()}}
+            try:
+                async with s.post(endpoint + "/", json=payload) as r:
+                    doc = await r.json()
+                if doc.get("result", {}).get("code", 1) == 0:
+                    ok += 1
+            except Exception as e:
+                print(f"send error: {e}", file=sys.stderr)
+            sent += 1
+            sleep = interval - (time.monotonic() - t0)
+            if sleep > 0:
+                await asyncio.sleep(sleep)
+    print(f"sent {sent} txs, {ok} accepted by CheckTx")
+    return 0
+
+
+def report(endpoint: str) -> int:
+    """Walk committed blocks; latency = block time - embedded send time."""
+    def rpc(path):
+        with urllib.request.urlopen(endpoint + "/" + path, timeout=10) as r:
+            return json.load(r)["result"]
+
+    status = rpc("status")
+    latest = int(status["sync_info"]["latest_block_height"])
+    base = int(status["sync_info"]["earliest_block_height"]) or 1
+    lats = []
+    for h in range(base, latest + 1):
+        blk = rpc(f"block?height={h}")
+        header_time = blk["block"]["header"]["time"]
+        from datetime import datetime, timezone
+
+        ts = header_time.rstrip("Z")
+        frac_ns = 0
+        if "." in ts:
+            ts, frac = ts.split(".", 1)
+            frac_ns = int(frac[:9].ljust(9, "0"))
+        block_ns = int(datetime.fromisoformat(ts).replace(
+            tzinfo=timezone.utc).timestamp()) * 10**9 + frac_ns
+        for raw in blk["block"]["data"]["txs"]:
+            parsed = parse_tx(base64.b64decode(raw))
+            if parsed is None:
+                continue
+            send_ns, _seq = parsed
+            lats.append((block_ns - send_ns) / 1e9)
+    if not lats:
+        print("no loadtime txs found in committed blocks")
+        return 1
+    lats.sort()
+
+    def pct(p):
+        return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+    print(json.dumps({
+        "txs": len(lats),
+        "latency_s": {"min": round(lats[0], 4), "p50": round(pct(0.5), 4),
+                      "p90": round(pct(0.9), 4), "p99": round(pct(0.99), 4),
+                      "max": round(lats[-1], 4)},
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="loadtime")
+    sub = p.add_subparsers(dest="command", required=True)
+    lp = sub.add_parser("load")
+    lp.add_argument("--endpoint", default="http://127.0.0.1:26657")
+    lp.add_argument("--rate", type=float, default=50.0)
+    lp.add_argument("--duration", type=float, default=10.0)
+    lp.add_argument("--size", type=int, default=128)
+    rp = sub.add_parser("report")
+    rp.add_argument("--endpoint", default="http://127.0.0.1:26657")
+    ns = p.parse_args(argv)
+    if ns.command == "load":
+        return asyncio.run(load(ns.endpoint, ns.rate, ns.duration, ns.size))
+    return report(ns.endpoint)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
