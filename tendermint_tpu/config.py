@@ -40,6 +40,9 @@ class BaseConfig:
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
     priv_validator_laddr: str = ""
+    # hex ed25519 pubkey of the authorized remote signer; when set, the
+    # SecretConnection handshake on priv_validator_laddr pins it
+    priv_validator_signer_key: str = ""
     node_key_file: str = "config/node_key.json"
     abci: str = "local"              # local | socket
     proxy_app: str = "kvstore"       # app name or tcp://host:port when socket

@@ -78,9 +78,12 @@ class BatchVoteVerifier:
 
         if not isinstance(pub, Ed25519PubKey):
             # rare key types never ride the ed25519 kernel (and must not
-            # poison the cache with a wrong-scheme verdict)
+            # poison the cache with a wrong-scheme verdict); off the loop so
+            # a flood of odd keys can't stall peer dispatch and timers
             self.stats["non_ed25519"] += 1
-            return pub.verify_signature(msg, sig)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, pub.verify_signature, msg, sig)
         pk = pub.bytes()
         key = self._key(pk, msg, sig)
         cached = self._cache.get(key)

@@ -78,10 +78,18 @@ class HTTPProvider(Provider):
         vals = _decode_validators(vals_doc["validators"])
         total = int(vals_doc["total"])
         page = 2
+        max_pages = -(-total // 100)  # ceil; a sane provider never needs more
         while len(vals) < total:
+            if page > max_pages:
+                raise ProviderError(
+                    f"provider returned {len(vals)}/{total} validators "
+                    f"after {max_pages} pages")
             more = await self.client.validators(sh.header.height, page=page,
                                                 per_page=100)
-            vals.extend(_decode_validators(more["validators"]))
+            got = _decode_validators(more["validators"])
+            if not got:
+                raise ProviderError("provider returned an empty validator page")
+            vals.extend(got)
             page += 1
         return LightBlock(sh, ValidatorSet(vals))
 

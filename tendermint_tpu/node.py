@@ -253,7 +253,20 @@ class Node:
 
             addr = config.base.priv_validator_laddr.split("://", 1)[-1]
             host, _, port = addr.rpartition(":")
-            endpoint = SignerListenerEndpoint(host or "127.0.0.1", int(port))
+            pinned = None
+            if config.base.priv_validator_signer_key:
+                try:
+                    pinned = bytes.fromhex(config.base.priv_validator_signer_key)
+                except ValueError as e:
+                    raise ValueError(
+                        "priv_validator_signer_key is not valid hex") from e
+                if len(pinned) != 32:
+                    raise ValueError(
+                        f"priv_validator_signer_key must be a 32-byte ed25519 "
+                        f"pubkey, got {len(pinned)} bytes")
+            endpoint = SignerListenerEndpoint(host or "127.0.0.1", int(port),
+                                              conn_key=node_key.priv_key,
+                                              expected_signer_key=pinned)
             endpoint.wait_for_signer()
             pv = SignerClient(endpoint, genesis.chain_id)
             pv.get_pub_key()  # fail fast if the signer is broken

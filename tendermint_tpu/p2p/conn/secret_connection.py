@@ -53,6 +53,29 @@ class HandshakeError(Exception):
     pass
 
 
+def _derive_session(loc_eph_pub: bytes, rem_eph_pub: bytes,
+                    dh_secret: bytes) -> Tuple[bytes, bytes, bytes]:
+    """Shared handshake key schedule (secret_connection.go:322-351).
+
+    Returns (send_key, recv_key, challenge) from the local perspective.
+    """
+    if dh_secret == b"\x00" * 32:
+        raise HandshakeError("low order point from remote peer")
+    lo, hi = sorted([loc_eph_pub, rem_eph_pub])
+    transcript = Transcript(_TRANSCRIPT_LABEL)
+    transcript.append_message(b"EPHEMERAL_LOWER_PUBLIC_KEY", lo)
+    transcript.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi)
+    transcript.append_message(b"DH_SECRET", dh_secret)
+    okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None,
+               info=_KDF_INFO).derive(dh_secret)
+    if loc_eph_pub == lo:
+        recv_key, send_key = okm[0:32], okm[32:64]
+    else:
+        send_key, recv_key = okm[0:32], okm[32:64]
+    challenge = transcript.challenge_bytes(b"SECRET_CONNECTION_MAC", 32)
+    return send_key, recv_key, challenge
+
+
 def _encode_bytes_value(b: bytes) -> bytes:
     w = pw.Writer()
     w.bytes(1, b)
@@ -112,6 +135,32 @@ class _Nonce:
             raise RuntimeError("nonce overflow; terminate session")
 
 
+# -- sans-I/O frame helpers shared by the async and blocking wrappers --------
+
+def _seal_frames(aead, nonce: _Nonce, data: bytes) -> bytes:
+    """Chunk ``data`` into sealed 1044-byte frames
+    (secret_connection.go:187 Write)."""
+    out = bytearray()
+    while data:
+        chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
+        frame = bytearray(TOTAL_FRAME_SIZE)
+        frame[0:4] = len(chunk).to_bytes(4, "little")
+        frame[4:4 + len(chunk)] = chunk
+        out += aead.encrypt(nonce.bytes(), bytes(frame), None)
+        nonce.incr()
+    return bytes(out)
+
+
+def _open_frame(aead, nonce: _Nonce, sealed: bytes) -> bytes:
+    """One sealed frame -> its data chunk (secret_connection.go:143 Read)."""
+    frame = aead.decrypt(nonce.bytes(), sealed, None)
+    nonce.incr()
+    chunk_len = int.from_bytes(frame[0:4], "little")
+    if chunk_len > DATA_MAX_SIZE:
+        raise RuntimeError("chunk length exceeds dataMaxSize")
+    return frame[4:4 + chunk_len]
+
+
 class SecretConnection:
     """Encrypted, authenticated stream over (reader, writer)."""
 
@@ -143,25 +192,9 @@ class SecretConnection:
         if len(rem_eph_pub) != 32:
             raise HandshakeError("bad ephemeral pubkey length")
 
-        lo, hi = sorted([loc_eph_pub, rem_eph_pub])
-        transcript = Transcript(_TRANSCRIPT_LABEL)
-        transcript.append_message(b"EPHEMERAL_LOWER_PUBLIC_KEY", lo)
-        transcript.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi)
-
         dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
-        if dh_secret == b"\x00" * 32:
-            raise HandshakeError("low order point from remote peer")
-        transcript.append_message(b"DH_SECRET", dh_secret)
-
-        loc_is_least = loc_eph_pub == lo
-        okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None,
-                   info=_KDF_INFO).derive(dh_secret)
-        if loc_is_least:
-            recv_key, send_key = okm[0:32], okm[32:64]
-        else:
-            send_key, recv_key = okm[0:32], okm[32:64]
-
-        challenge = transcript.challenge_bytes(b"SECRET_CONNECTION_MAC", 32)
+        send_key, recv_key, challenge = _derive_session(
+            loc_eph_pub, rem_eph_pub, dh_secret)
 
         sc = cls(reader, writer, send_key, recv_key, remote_pubkey=None)
 
@@ -180,15 +213,7 @@ class SecretConnection:
 
     async def write(self, data: bytes) -> None:
         """Chunk into sealed frames (secret_connection.go:187 Write)."""
-        while data:
-            chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
-            frame = bytearray(TOTAL_FRAME_SIZE)
-            frame[0:4] = len(chunk).to_bytes(4, "little")
-            frame[4:4 + len(chunk)] = chunk
-            sealed = self._send_aead.encrypt(self._send_nonce.bytes(),
-                                             bytes(frame), None)
-            self._send_nonce.incr()
-            self._writer.write(sealed)
+        self._writer.write(_seal_frames(self._send_aead, self._send_nonce, data))
         await self._writer.drain()
 
     async def read(self) -> bytes:
@@ -197,12 +222,7 @@ class SecretConnection:
             out, self._recv_buffer = self._recv_buffer, b""
             return out
         sealed = await self._reader.readexactly(SEALED_FRAME_SIZE)
-        frame = self._recv_aead.decrypt(self._recv_nonce.bytes(), sealed, None)
-        self._recv_nonce.incr()
-        chunk_len = int.from_bytes(frame[0:4], "little")
-        if chunk_len > DATA_MAX_SIZE:
-            raise RuntimeError("chunk length exceeds dataMaxSize")
-        return frame[4:4 + chunk_len]
+        return _open_frame(self._recv_aead, self._recv_nonce, sealed)
 
     async def read_exactly(self, n: int) -> bytes:
         out = b""
@@ -240,4 +260,128 @@ class SecretConnection:
         try:
             self._writer.close()
         except Exception:
+            pass
+
+
+def _sock_recv_exact(sock, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("secret connection closed")
+        out += chunk
+    return out
+
+
+def _sock_read_length_delimited(sock, max_size: int = 1024) -> bytes:
+    """Blocking twin of _read_length_delimited (handshake plaintext phase)."""
+    length = 0
+    shift = 0
+    while True:
+        b = _sock_recv_exact(sock, 1)
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise HandshakeError("varint length overflow")
+    if length > max_size:
+        raise HandshakeError(f"handshake message too large: {length}")
+    return _sock_recv_exact(sock, length)
+
+
+class SyncSecretConnection:
+    """The same STS protocol over a blocking socket, for threaded endpoints
+    (the remote-signer privval connection — reference wraps tcp:// privval
+    links in SecretConnection, privval/socket_listeners.go:66).
+
+    Wire-compatible with :class:`SecretConnection`; one may sit on either
+    end of the other.
+    """
+
+    def __init__(self, sock, send_key: bytes, recv_key: bytes,
+                 remote_pubkey: Optional[PubKey]):
+        self._sock = sock
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+        self._recv_buffer = b""
+        self.remote_pubkey = remote_pubkey
+
+    def _recv_exact(self, n: int) -> bytes:
+        return _sock_recv_exact(self._sock, n)
+
+    @classmethod
+    def make(cls, sock, local_priv: PrivKey,
+             expected_remote_key: Optional[bytes] = None) -> "SyncSecretConnection":
+        eph_priv = X25519PrivateKey.generate()
+        loc_eph_pub = eph_priv.public_key().public_bytes_raw()
+
+        sock.sendall(_encode_bytes_value(loc_eph_pub))
+        rem_msg = _sock_read_length_delimited(sock)
+        rem_fields = pw.fields_dict(rem_msg)
+        rem_eph_pub = rem_fields.get(1, [b""])[0]
+        if len(rem_eph_pub) != 32:
+            raise HandshakeError("bad ephemeral pubkey length")
+
+        dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
+        send_key, recv_key, challenge = _derive_session(
+            loc_eph_pub, rem_eph_pub, dh_secret)
+
+        sc = cls(sock, send_key, recv_key, remote_pubkey=None)
+        sig = local_priv.sign(challenge)
+        sc.write(_encode_auth_sig(local_priv.pub_key(), sig))
+        auth_body = sc.read_msg(max_size=1024)
+        ln, pos = pw.decode_varint(auth_body, 0)
+        rem_pub, rem_sig = _decode_auth_sig(auth_body[pos:pos + ln])
+        if not rem_pub.verify_signature(challenge, rem_sig):
+            raise HandshakeError("challenge verification failed")
+        if (expected_remote_key is not None
+                and rem_pub.bytes() != expected_remote_key):
+            raise HandshakeError("remote static key does not match expected key")
+        sc.remote_pubkey = rem_pub
+        return sc
+
+    def write(self, data: bytes) -> None:
+        self._sock.sendall(_seal_frames(self._send_aead, self._send_nonce, data))
+
+    def read(self) -> bytes:
+        if self._recv_buffer:
+            out, self._recv_buffer = self._recv_buffer, b""
+            return out
+        sealed = self._recv_exact(SEALED_FRAME_SIZE)
+        return _open_frame(self._recv_aead, self._recv_nonce, sealed)
+
+    def read_exactly(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.read()
+            if not chunk:
+                raise ConnectionError("secret connection closed")
+            take = min(n - len(out), len(chunk))
+            out += chunk[:take]
+            self._recv_buffer = chunk[take:] + self._recv_buffer
+        return out
+
+    def read_msg(self, max_size: int = 1024 * 1024) -> bytes:
+        """uvarint-length-delimited message; returns prefix+body."""
+        header = b""
+        while True:
+            b = self.read_exactly(1)
+            header += b
+            if not b[0] & 0x80:
+                break
+            if len(header) > 5:
+                raise RuntimeError("varint overflow")
+        length, _ = pw.decode_varint(header, 0)
+        if length > max_size:
+            raise RuntimeError(f"message too large: {length}")
+        body = self.read_exactly(length)
+        return header + body
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
             pass

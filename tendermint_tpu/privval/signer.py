@@ -12,6 +12,11 @@ length-delimited protobuf (proto/tendermint/privval/types.proto oneof):
     5 SignProposalRequest{proposal, ...}  6 SignedProposalResponse{...}
     7 PingRequest                    8 PingResponse
 
+The TCP link is wrapped in SecretConnection with ed25519 peer
+authentication, as the reference wraps tcp:// privval connections
+(privval/socket_listeners.go:66 TCPListener → secret conn); either side
+may additionally pin the peer's expected static key.
+
 Blocking sockets on background threads, mirroring the reference's blocking
 call discipline: consensus' synchronous sign_vote/sign_proposal calls block
 until the signer answers (or time out).
@@ -24,8 +29,9 @@ import socket
 import threading
 from typing import Optional, Tuple
 
-from ..crypto import Ed25519PubKey, PubKey
+from ..crypto import Ed25519PrivKey, Ed25519PubKey, PrivKey, PubKey
 from ..libs import protowire as pw
+from ..p2p.conn.secret_connection import SyncSecretConnection
 from ..types.priv_validator import PrivValidator
 from ..types.proposal import Proposal
 from ..types.vote import Vote
@@ -33,6 +39,8 @@ from ..types.vote import Vote
 logger = logging.getLogger("tmtpu.privval.signer")
 
 DEFAULT_TIMEOUT = 5.0
+# Votes/proposals are tiny; anything beyond this is a broken or hostile peer.
+MAX_PRIVVAL_MSG = 64 * 1024
 
 
 class RemoteSignerError(Exception):
@@ -47,24 +55,10 @@ def _frame(field: int, body: bytes) -> bytes:
     return pw.length_delimited(w.finish())
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[int, bytes]:
-    length = 0
-    shift = 0
-    while True:
-        b = sock.recv(1)
-        if not b:
-            raise ConnectionError("signer connection closed")
-        length |= (b[0] & 0x7F) << shift
-        if not b[0] & 0x80:
-            break
-        shift += 7
-    data = b""
-    while len(data) < length:
-        chunk = sock.recv(length - len(data))
-        if not chunk:
-            raise ConnectionError("signer connection closed mid-message")
-        data += chunk
-    for fn, _wt, v in pw.iter_fields(data):
+def _recv_msg(conn: SyncSecretConnection) -> Tuple[int, bytes]:
+    framed = conn.read_msg(max_size=MAX_PRIVVAL_MSG)
+    ln, pos = pw.decode_varint(framed, 0)
+    for fn, _wt, v in pw.iter_fields(framed[pos:pos + ln]):
         return fn, v
     raise RemoteSignerError("empty privval message")
 
@@ -79,12 +73,21 @@ def _err_body(msg: str) -> bytes:
 # -- signer side (dials the node; privval/signer_server.go) -------------------
 
 class SignerServer:
-    """Runs next to the key: dials the node and serves its FilePV."""
+    """Runs next to the key: dials the node and serves its FilePV.
 
-    def __init__(self, pv: PrivValidator, chain_id: str, addr: Tuple[str, int]):
+    ``conn_key`` is the signer's long-lived connection identity for the
+    SecretConnection handshake (generated if absent); ``expected_node_key``
+    optionally pins the node's static ed25519 key.
+    """
+
+    def __init__(self, pv: PrivValidator, chain_id: str, addr: Tuple[str, int],
+                 conn_key: Optional[PrivKey] = None,
+                 expected_node_key: Optional[bytes] = None):
         self.pv = pv
         self.chain_id = chain_id
         self.addr = addr
+        self.conn_key = conn_key or Ed25519PrivKey.generate()
+        self.expected_node_key = expected_node_key
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -103,22 +106,35 @@ class SignerServer:
                 pass
 
     def _run(self) -> None:
+        # catch broadly: handshake failures, AEAD InvalidTag, oversized
+        # frames etc. must redial, not silently kill the signer thread
         while not self._stopped.is_set():
             try:
                 self._sock = socket.create_connection(self.addr, timeout=5.0)
+                # keep the 5s timeout through the handshake so a mute or
+                # half-open peer can't wedge the thread; block indefinitely
+                # only once serving (requests arrive at the node's pace)
+                conn = SyncSecretConnection.make(
+                    self._sock, self.conn_key,
+                    expected_remote_key=self.expected_node_key)
                 self._sock.settimeout(None)
                 logger.info("signer connected to %s:%d", *self.addr)
-                self._serve(self._sock)
-            except (ConnectionError, OSError) as e:
+                self._serve(conn)
+            except Exception as e:
                 if self._stopped.is_set():
                     return
                 logger.warning("signer connection lost (%s); redialing", e)
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
                 self._stopped.wait(1.0)
 
-    def _serve(self, sock: socket.socket) -> None:
+    def _serve(self, conn: SyncSecretConnection) -> None:
         while not self._stopped.is_set():
-            fn, body = _recv_msg(sock)
-            sock.sendall(self._handle(fn, body))
+            fn, body = _recv_msg(conn)
+            conn.write(self._handle(fn, body))
 
     def _handle(self, fn: int, body: bytes) -> bytes:
         fields = pw.fields_dict(body) if body else {}
@@ -162,38 +178,106 @@ class SignerServer:
 # -- node side (listens; privval/signer_listener_endpoint.go + client) --------
 
 class SignerListenerEndpoint:
-    """Accepts the signer's inbound connection on priv_validator_laddr."""
+    """Accepts the signer's inbound connection on priv_validator_laddr.
 
-    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT):
+    ``conn_key`` is the node's connection identity (normally the node key);
+    ``expected_signer_key`` optionally pins the signer's static key so only
+    the authorized signer process can serve signatures.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT,
+                 conn_key: Optional[PrivKey] = None,
+                 expected_signer_key: Optional[bytes] = None):
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
-        self._conn: Optional[socket.socket] = None
+        self.conn_key = conn_key or Ed25519PrivKey.generate()
+        self.expected_signer_key = expected_signer_key
+        self._conn: Optional[SyncSecretConnection] = None
+        self._connected = threading.Event()
         self._lock = threading.Lock()
         self.timeout = timeout
         self._stopped = False
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def _accept_loop(self) -> None:
+        """Keep accepting: a failed handshake (port scanner, wrong pinned
+        key) drops that conn and waits for the next — it must never wedge
+        the endpoint (the reference listener likewise keeps accepting).
+        Each handshake runs on its own thread so a stalling dialer cannot
+        starve the real signer's reconnect."""
+        # finite accept timeout: close(2) does not wake a thread blocked in
+        # accept(2), so the loop polls _stopped to actually exit (and free
+        # the bound port) after close()
+        self._listener.settimeout(1.0)
+        while not self._stopped:
+            try:
+                sock, addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._handshake_one, args=(sock, addr),
+                             daemon=True, name="signer-handshake").start()
+
+    def _handshake_one(self, sock: socket.socket, addr) -> None:
+        try:
+            sock.settimeout(self.timeout)
+            conn = SyncSecretConnection.make(
+                sock, self.conn_key,
+                expected_remote_key=self.expected_signer_key)
+        except Exception as e:
+            logger.warning("rejecting signer connection from %s: %s", addr, e)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            if self._conn is not None:
+                # never evict a live authenticated signer — an unauthorized
+                # dialer completing a handshake must not hijack the link;
+                # a dead conn is cleared by request()'s failure teardown
+                logger.warning("signer already connected; dropping conn "
+                               "from %s", addr)
+                conn.close()
+                return
+            self._conn = conn
+        self._connected.set()
+        logger.info("remote signer connected from %s", addr)
 
     def wait_for_signer(self, timeout: float = 30.0) -> None:
-        self._listener.settimeout(timeout)
-        conn, addr = self._listener.accept()
-        conn.settimeout(self.timeout)
-        self._conn = conn
-        logger.info("remote signer connected from %s", addr)
+        if self._accept_thread is None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True, name="signer-accept")
+            self._accept_thread.start()
+        if not self._connected.wait(timeout):
+            raise RemoteSignerError("no signer connected within deadline")
 
     def request(self, framed: bytes) -> Tuple[int, bytes]:
         with self._lock:  # one in-flight request (reference serializes too)
             if self._conn is None:
                 raise RemoteSignerError("no signer connected")
-            self._conn.sendall(framed)
-            return _recv_msg(self._conn)
+            try:
+                self._conn.write(framed)
+                return _recv_msg(self._conn)
+            except Exception as e:
+                # a timeout or frame error desyncs the AEAD stream — tear the
+                # conn down; the signer redials and the accept loop re-arms
+                self._conn.close()
+                self._conn = None
+                self._connected.clear()
+                raise RemoteSignerError(f"signer request failed: {e}") from e
 
     def close(self) -> None:
         self._stopped = True
-        for s in (self._conn, self._listener):
-            if s is not None:
-                try:
-                    s.close()
-                except OSError:
-                    pass
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+        try:
+            self._listener.close()
+        except OSError:
+            pass
 
 
 class SignerClient(PrivValidator):

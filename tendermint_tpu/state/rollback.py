@@ -46,7 +46,10 @@ def rollback_state(block_store, state_store: StateStore) -> Tuple[int, bytes]:
         raise RollbackError(f"no validators at height {rollback_height}")
     prev_params = state_store.load_consensus_params(rollback_height + 1)
     if prev_params is None:
-        prev_params = invalid.consensus_params
+        # the reference errors here (state/rollback.go); silently carrying the
+        # invalid state's params would resurrect a post-change param set
+        raise RollbackError(
+            f"no consensus params at height {rollback_height + 1}")
 
     val_change = invalid.last_height_validators_changed
     if val_change == invalid.last_block_height + 1:

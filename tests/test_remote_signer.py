@@ -62,3 +62,44 @@ def test_remote_signer_round_trip():
     finally:
         server.stop()
         endpoint.close()
+
+
+def test_signer_connection_is_encrypted_and_pinned():
+    """The privval link rides SecretConnection; pinning the wrong signer key
+    must refuse the connection (advisor r3: plaintext privval TCP). The
+    accept loop drops the bad conn and keeps accepting rather than
+    crashing node startup."""
+    from tendermint_tpu.crypto import Ed25519PrivKey
+
+    pv = FilePV.generate("", "")
+    signer_key = Ed25519PrivKey.generate()
+    wrong_key = Ed25519PrivKey.generate()
+
+    # wrong pinned key: endpoint rejects the conn; wait deadline expires
+    endpoint = SignerListenerEndpoint(
+        "127.0.0.1", 0,
+        expected_signer_key=wrong_key.pub_key().bytes())
+    server = SignerServer(pv, CHAIN, ("127.0.0.1", endpoint.port),
+                          conn_key=signer_key)
+    server.start()
+    try:
+        with pytest.raises(RemoteSignerError):
+            endpoint.wait_for_signer(timeout=2.5)
+    finally:
+        server.stop()
+        endpoint.close()
+
+    # right pinned key: serves normally
+    endpoint = SignerListenerEndpoint(
+        "127.0.0.1", 0,
+        expected_signer_key=signer_key.pub_key().bytes())
+    server = SignerServer(pv, CHAIN, ("127.0.0.1", endpoint.port),
+                          conn_key=signer_key)
+    server.start()
+    try:
+        endpoint.wait_for_signer(timeout=10.0)
+        client = SignerClient(endpoint, CHAIN)
+        assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    finally:
+        server.stop()
+        endpoint.close()
