@@ -1,23 +1,27 @@
-"""Benchmark: Ed25519 commit-verification throughput, TPU stream vs host scalar.
+"""Benchmarks: every BASELINE.md config, one JSON line each.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Output contract: each line is {"metric", "value", "unit", "vs_baseline"}.
+The FLAGSHIP metric — sustained VerifyCommit throughput at 10,240
+validators (the north star scale, reference types/validator_set.go:667) —
+prints LAST so the driver records it.
 
-BASELINE.json config #1: the batched verifier on realistic vote sign-bytes
-(identical in shape to types.Commit.vote_sign_bytes output), measured as
-*sustained* throughput — a stream of 1024-signature chunks verified by one
-``lax.scan`` inside a single device execution. That is the shape of the real
-hot paths (fast-sync replay, 10k-validator commits, vote-stream batches):
-dispatching one jitted call has a large fixed cost on remote-attached TPUs
-(~100 ms through a relay), so per-call latency at batch 1024 measures the
-link, not the machine; the stream amortizes it exactly the way the
-consensus/blocksync callers do.
+Configs (BASELINE.json):
+  1  Ed25519 batched stream, CHUNK-sig chunks scanned in one execution
+  2  ValidatorSet.VerifyCommit over a 150-validator commit (one-shot)
+  3  VerifyCommitLight+Trusting over a 1000-validator header chain
+  4  4-node localnet (kvstore), consensus end-to-end blocks/min
+  5  fast-sync windowed replay @ 1000 validators
+  10k  sustained VerifyCommit @ 10,240 validators (flagship, last)
 
-Baseline = the host scalar loop (OpenSSL-backed PubKey.verify_signature, the
-stand-in for the reference's Go x/crypto ed25519.Verify hot call at
-crypto/ed25519/ed25519.go:148-155), measured on a 2048-signature subset.
+Baselines: configs 1/2/3/5/10k measure the host scalar loop (OpenSSL-backed
+PubKey.verify_signature — the stand-in for the reference's Go x/crypto
+ed25519.Verify hot call, crypto/ed25519/ed25519.go:148-155) in the same
+process. Config 4's baseline is the reference QA testnet's 19.5 blocks/min
+(docs/qa/v034/README.md:141-142; 200-node WAN vs 4-node localhost — an
+anchor, not an equal-hardware comparison).
 
-Timing includes host-side packing (prepare_batch) — the device path is
-charged end-to-end, same as the baseline loop.
+The device path is charged end-to-end: host packing + transfer + kernel +
+verdict fetch, exactly what the consensus/blocksync callers pay.
 """
 
 import argparse
@@ -28,8 +32,26 @@ import time
 import numpy as np
 
 N_STREAM = 32768
-CHUNK = 1024
+CHUNK = 2048
 N_BASE = 2048
+
+
+def _enable_compile_cache():
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
+
+
+def _emit(metric: str, value: float, unit: str, vs_baseline: float, **extra):
+    line = {"metric": metric, "value": round(value, 3), "unit": unit,
+            "vs_baseline": round(vs_baseline, 3)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
 
 
 def build_batch(n: int):
@@ -56,42 +78,37 @@ def build_batch(n: int):
     return pks, msgs, sigs, pubs
 
 
-def main():
+def _host_rate(pubs, msgs, sigs, n: int) -> float:
+    """Host scalar loop sigs/s on an n-item subset."""
+    t0 = time.perf_counter()
+    ok = all(pub.verify_signature(m, s)
+             for pub, m, s in zip(pubs[:n], msgs[:n], sigs[:n]))
+    elapsed = time.perf_counter() - t0
+    assert ok
+    return n / elapsed
+
+
+def bench_stream():
+    """Config #1: sustained batched-verifier throughput on vote sign-bytes."""
     pks, msgs, sigs, pubs = build_batch(N_STREAM)
 
     from tendermint_tpu.crypto.ed25519_jax import batch_verify_stream
 
-    # warmup: compile the stream kernel at the measured shape (cached across
-    # runs by the jax persistent cache when available)
-    out = batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)
+    out = batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)  # compile
     assert np.asarray(out).all(), "warmup stream rejected valid sigs"
-
-    # device path: best of 3 timed runs, end-to-end incl. host packing
-    device_times = []
-    for _ in range(3):
+    times = []
+    for _ in range(4):
         t0 = time.perf_counter()
         out = batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)
-        device_times.append(time.perf_counter() - t0)
+        times.append(time.perf_counter() - t0)
     assert np.asarray(out).all()
-    device_sigs_per_sec = N_STREAM / min(device_times)
-
-    # host scalar baseline (the reference's one-verify-per-signature loop)
-    t0 = time.perf_counter()
-    ok = all(pub.verify_signature(m, s)
-             for pub, m, s in zip(pubs[:N_BASE], msgs[:N_BASE], sigs[:N_BASE]))
-    host_elapsed = time.perf_counter() - t0
-    assert ok
-    host_sigs_per_sec = N_BASE / host_elapsed
-
-    print(json.dumps({
-        "metric": "verify_commit_sigs_per_sec_stream1024",
-        "value": round(device_sigs_per_sec, 1),
-        "unit": "sigs/s",
-        "vs_baseline": round(device_sigs_per_sec / host_sigs_per_sec, 3),
-    }))
+    dev = N_STREAM / min(times)
+    host = _host_rate(pubs, msgs, sigs, N_BASE)
+    _emit(f"verify_commit_sigs_per_sec_stream{CHUNK}", dev, "sigs/s",
+          dev / host, chunk=CHUNK)
 
 
-# --- BASELINE configs #2/#3/#5 (VerifyCommit paths) -------------------------
+# --- commit helpers ---------------------------------------------------------
 
 def _mk_val_set(n_vals: int, seed: int = 7):
     """A validator set + its signing keys (OpenSSL), reusable across heights."""
@@ -149,7 +166,9 @@ def _timed(fn, warm: int = 1, runs: int = 3) -> float:
 
 def bench_verify_commit_150():
     """Config #2: ValidatorSet.VerifyCommit over a 150-validator commit
-    (reference types/validator_set.go:667)."""
+    (reference types/validator_set.go:667). One-shot: a single interactive
+    commit pays the full dispatch latency, so through a remote relay the
+    auto backend keeps it on host (break-even ~16 sigs on local silicon)."""
     vs, keys = _mk_val_set(150)
     commit, bid = _sign_commit(vs, keys, 100, "bench-150")
     dev = _timed(lambda: vs.verify_commit("bench-150", bid, 100, commit))
@@ -158,11 +177,8 @@ def bench_verify_commit_150():
         host = _timed(lambda: vs.verify_commit("bench-150", bid, 100, commit))
     finally:
         del os.environ["TMTPU_BATCH_BACKEND"]
-    print(json.dumps({
-        "metric": "verify_commit_150_vals_sigs_per_sec",
-        "value": round(150 / dev, 1), "unit": "sigs/s",
-        "vs_baseline": round(host / dev, 3),
-    }))
+    _emit("verify_commit_150_vals_sigs_per_sec", 150 / dev, "sigs/s",
+          host / dev)
 
 
 def bench_light_chain_1000():
@@ -212,17 +228,14 @@ def bench_light_chain_1000():
         del os.environ["TMTPU_BATCH_BACKEND"]
     # sigs verified per pass: trusting tallies ~all, light stops at 2/3
     sigs = n_headers * (n_vals + 2 * n_vals // 3 + 1)
-    print(json.dumps({
-        "metric": "light_chain_1000_vals_sigs_per_sec",
-        "value": round(sigs / dev, 1), "unit": "sigs/s",
-        "vs_baseline": round(host / dev, 3),
-    }))
+    _emit("light_chain_1000_vals_sigs_per_sec", sigs / dev, "sigs/s",
+          host / dev)
 
 
 def bench_fast_sync_replay():
     """Config #5 (scaled): the block-sync engine's windowed batched commit
     verification over a 1000-validator chain (reference
-    blockchain/v0/reactor.go:255; our blockchain/reactor.py:186). Measures
+    blockchain/v0/reactor.go:255; our blockchain/reactor.py). Measures
     the verification plane, which is the reference's fast-sync bottleneck."""
     from tendermint_tpu.types.validator_set import verify_commit_light_batched
 
@@ -244,19 +257,198 @@ def bench_fast_sync_replay():
         host = _timed(replay, warm=0, runs=1)
     finally:
         del os.environ["TMTPU_BATCH_BACKEND"]
-    print(json.dumps({
-        "metric": "fast_sync_1000_vals_blocks_per_sec",
-        "value": round(n_blocks / dev, 2), "unit": "blocks/s",
-        "vs_baseline": round(host / dev, 3),
-    }))
+    _emit("fast_sync_1000_vals_blocks_per_sec", n_blocks / dev, "blocks/s",
+          host / dev)
+
+
+def bench_localnet():
+    """Config #4: 4-node localnet over TCP (kvstore app), consensus reactor
+    end-to-end. Measures blocks/min across the net and broadcast_tx_commit
+    latency. Baseline anchor: reference 200-node QA testnet 19.5 blocks/min
+    (docs/qa/v034/README.md:141-142)."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    root = tempfile.mkdtemp(prefix="bench-localnet-")
+    port0 = 28656
+
+    def rpc(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+    procs = []
+    try:
+        subprocess.run(
+            ["python", "-m", "tendermint_tpu.cmd", "testnet", "--v", "4",
+             "--output-dir", root, "--chain-id", "bench-e2e",
+             "--starting-port", str(port0)],
+            check=True, capture_output=True, timeout=120)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for i in range(4):
+            procs.append(subprocess.Popen(
+                ["python", "-m", "tendermint_tpu.cmd", "--home",
+                 f"{root}/node{i}", "start", "--log-level", "error"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        # wait for liveness
+        deadline = time.time() + 120
+        h0 = None
+        while time.time() < deadline:
+            try:
+                h0 = int(rpc(port0 + 1, "status")
+                         ["result"]["sync_info"]["latest_block_height"])
+                if h0 >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert h0 is not None and h0 >= 2, "localnet failed to start"
+
+        # measure block rate over a fixed window + tx commit latency
+        t0 = time.time()
+        start_h = int(rpc(port0 + 1, "status")
+                      ["result"]["sync_info"]["latest_block_height"])
+        tx_lat = []
+        n_txs = 5
+        for i in range(n_txs):
+            body = json.dumps({
+                "jsonrpc": "2.0", "id": 1, "method": "broadcast_tx_commit",
+                "params": {"tx": __import__("base64").b64encode(
+                    f"bench{i}=v{i}".encode()).decode()}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port0 + 1}/", data=body,
+                headers={"Content-Type": "application/json"})
+            t1 = time.time()
+            with urllib.request.urlopen(req, timeout=30) as r:
+                resp = json.loads(r.read())
+            tx_lat.append(time.time() - t1)
+            assert resp["result"]["deliver_tx"].get("code", 0) == 0
+        elapsed = time.time() - t0
+        end_h = int(rpc(port0 + 1, "status")
+                    ["result"]["sync_info"]["latest_block_height"])
+        blocks_per_min = (end_h - start_h) / elapsed * 60.0
+        _emit("localnet_4node_tx_commit_latency_p50", float(np.median(tx_lat)),
+              "s", 0.0)
+        _emit("localnet_4node_blocks_per_min", blocks_per_min, "blocks/min",
+              blocks_per_min / 19.5)
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_verify_commit_10k():
+    """FLAGSHIP (north star): VerifyCommit at 10,240 validators — the scale
+    BASELINE.json names (≥15x target vs the host scalar loop, reference
+    types/validator_set.go:667, docs/qa/v034). Two numbers:
+
+    * sustained: a fast-sync-shaped stream of full commits, window of 2
+      commits (20,480 sigs) per device execution — the shape of catch-up
+      replay and of a busy consensus net;
+    * one-shot: a single cold commit in one call, paying full dispatch
+      latency (dominated by the relay's fixed cost on remote TPUs).
+
+    Also prints a stage breakdown (pack / device+transfer) so regressions
+    are attributable.
+    """
+    from tendermint_tpu import crypto
+    from tendermint_tpu.crypto.ed25519_jax import verify as V
+
+    n_vals, n_commits, window = 10240, 6, 3
+    vs, keys = _mk_val_set(n_vals)
+    chain = "bench-10k"
+    commits = [_sign_commit(vs, keys, h, chain)[0]
+               for h in range(1, n_commits + 1)]
+    # flatten (pk, msg, sig) in valset order, per commit
+    per_commit = []
+    for c in commits:
+        pks = [v.pub_key.bytes() for v in vs.validators]
+        msgs = [c.vote_sign_bytes(chain, i) for i in range(n_vals)]
+        sigs = [cs.signature for cs in c.signatures]
+        per_commit.append((pks, msgs, sigs))
+
+    def verify_window(cs):
+        pks = [p for c in cs for p in c[0]]
+        msgs = [m for c in cs for m in c[1]]
+        sigs = [s for c in cs for s in c[2]]
+        out = V.batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)
+        assert out.all()
+
+    def sustained():
+        for i in range(0, n_commits, window):
+            verify_window(per_commit[i:i + window])
+
+    sustained()  # compile + warm the pk device cache
+    best = _timed(sustained, warm=0, runs=3)
+    total_sigs = n_commits * n_vals
+    dev_rate = total_sigs / best
+
+    # host scalar baseline on a subset
+    pubs = [crypto.Ed25519PubKey(p) for p in per_commit[0][0][:N_BASE]]
+    host_rate = _host_rate(pubs, per_commit[0][1], per_commit[0][2], N_BASE)
+
+    # stage breakdown for the sustained path
+    t0 = time.perf_counter()
+    for i in range(0, n_commits, window):
+        cs = per_commit[i:i + window]
+        V.prepare_sparse_stream([p for c in cs for p in c[0]],
+                                [m for c in cs for m in c[1]],
+                                [s for c in cs for s in c[2]], CHUNK)
+    pack_s = time.perf_counter() - t0
+
+    # one-shot: single commit, one call
+    one = _timed(lambda: verify_window(per_commit[:1]), warm=1, runs=3)
+    _emit("verify_commit_10k_oneshot_sigs_per_sec", n_vals / one, "sigs/s",
+          (n_vals / one) / host_rate)
+    _emit("verify_commit_10k_breakdown_pack_share", pack_s / best, "ratio",
+          0.0, pack_seconds=round(pack_s, 3), total_seconds=round(best, 3))
+    _emit("verify_commit_10k_sigs_per_sec", dev_rate, "sigs/s",
+          dev_rate / host_rate)
+
+
+CONFIGS = {
+    "1": bench_stream,
+    "2": bench_verify_commit_150,
+    "3": bench_light_chain_1000,
+    "4": bench_localnet,
+    "5": bench_fast_sync_replay,
+    "10k": bench_verify_commit_10k,
+}
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=1, choices=(1, 2, 3, 5),
-                    help="BASELINE.json config: 1=batch stream (default, the "
-                         "driver metric), 2=VerifyCommit@150, 3=light chain "
-                         "@1000, 5=fast-sync replay @1000")
+    ap.add_argument("--config", default="all",
+                    choices=list(CONFIGS) + ["all"],
+                    help="BASELINE.json config; default runs every config, "
+                         "flagship (10k) last")
     args = ap.parse_args()
-    {1: main, 2: bench_verify_commit_150, 3: bench_light_chain_1000,
-     5: bench_fast_sync_replay}[args.config]()
+    _enable_compile_cache()
+    if args.config == "all":
+        # flagship last: the driver records the final line. The remote
+        # relay occasionally drops a compile mid-flight — retry each
+        # config once before reporting it failed.
+        for key in ("2", "3", "4", "5", "1", "10k"):
+            for attempt in (1, 2):
+                try:
+                    CONFIGS[key]()
+                    break
+                except Exception as e:
+                    if attempt == 2:
+                        _emit(f"config_{key}_failed", 0.0, "error", 0.0,
+                              error=f"{type(e).__name__}: {e}")
+                    else:
+                        time.sleep(5.0)
+    else:
+        CONFIGS[args.config]()

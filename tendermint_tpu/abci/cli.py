@@ -69,12 +69,18 @@ def console(client: SocketClient) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="abci-cli")
     p.add_argument("--address", default="tcp://127.0.0.1:26658")
+    p.add_argument("--transport", default="socket", choices=("socket", "grpc"))
     p.add_argument("command", choices=["echo", "info", "deliver_tx",
                                        "check_tx", "commit", "query",
                                        "console"])
     p.add_argument("args", nargs="*")
     ns = p.parse_args(argv)
-    client = SocketClient(ns.address)
+    if ns.transport == "grpc":
+        from .grpc import GrpcClient
+
+        client = GrpcClient(ns.address)
+    else:
+        client = SocketClient(ns.address)
     try:
         if ns.command == "console":
             return console(client)

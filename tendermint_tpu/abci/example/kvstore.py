@@ -189,3 +189,65 @@ def parse_validator_tx(tx: bytes) -> "Optional[tuple[str, int]]":
         return pubkey_hex, power
     except (ValueError, UnicodeDecodeError):
         return None
+
+
+class MerkleKVStoreApplication(SnapshotKVStoreApplication):
+    """kvstore whose app hash is an RFC-6962 merkle root over the sorted
+    key/value state, serving merkle ``ProofOps`` on ``Query(prove=True)`` —
+    the proof path the reference's light proxy verifies queries with
+    (light/rpc/client.go ABCIQueryWithOptions → merkle.ProofRuntime;
+    leaf encoding per crypto/merkle/proof_value.go ValueOp).
+
+    The proof at query height H verifies against the app hash carried in
+    HEADER H+1 (AppHash(H+1) = Commit(H) result), exactly the reference's
+    height convention.
+    """
+
+    @staticmethod
+    def _leaf_items(state: Dict[str, str]) -> List[bytes]:
+        from ...crypto.merkle import _encode_byte_slice
+
+        items = []
+        for k in sorted(state):
+            vhash = hashlib.sha256(state[k].encode()).digest()
+            items.append(_encode_byte_slice(k.encode())
+                         + _encode_byte_slice(vhash))
+        return items
+
+    def commit(self) -> abci.ResponseCommit:
+        from ...crypto.merkle import hash_from_byte_slices
+
+        resp = super().commit()
+        self.app_hash = hash_from_byte_slices(self._leaf_items(self.state))
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        resp = super().query(req)
+        # proofs exist only for the KV store path; /val and missing keys
+        # answer unproven (the light proxy then refuses to vouch for them)
+        key = req.data.decode("utf-8", errors="replace")
+        if (req.prove and resp.code == 0 and resp.value
+                and req.path in ("", "/store") and key in self.state):
+            from ...crypto.merkle import (
+                ProofOp,
+                ValueOp,
+                proofs_from_byte_slices,
+            )
+
+            idx = sorted(self.state).index(key)
+            proof = proofs_from_byte_slices(self._leaf_items(self.state))[idx]
+            op = ValueOp(req.data, proof).proof_op()
+            resp.proof_ops = [ProofOp(op.type, op.key, op.data)]
+        return resp
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk
+                             ) -> abci.ResponseApplySnapshotChunk:
+        from ...crypto.merkle import hash_from_byte_slices
+
+        resp = super().apply_snapshot_chunk(req)
+        if (self._restore is None
+                and resp.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT):
+            # restore completed: the app hash is the merkle root, not the
+            # parent's tx-count encoding
+            self.app_hash = hash_from_byte_slices(self._leaf_items(self.state))
+        return resp

@@ -1,0 +1,170 @@
+"""ABCI over gRPC — the third ABCI transport (reference
+abci/client/grpc_client.go:22, abci/server/grpc_server.go:13).
+
+Service ``tendermint.abci.ABCIApplication``: one unary RPC per ABCI method,
+carrying the BARE RequestX/ResponseX protobuf bodies (not the oneof
+envelope the socket transport frames). No generated stubs: grpcio's generic
+handler API plus this package's hand-rolled gogoproto-exact codec
+(proto_codec._enc_request_body/_dec_response_body) keep the wire identical
+to the reference's generated types.pb.go.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from . import types as abci
+from .application import Application
+from .client import Client
+from .proto_codec import (
+    _dec_request_body,
+    _dec_response_body,
+    _enc_request_body,
+    _enc_response_body,
+)
+
+logger = logging.getLogger("tmtpu.abci.grpc")
+
+SERVICE = "tendermint.abci.ABCIApplication"
+
+# gRPC method name -> (codec method key, Application handler name)
+_METHODS = {
+    "Echo": ("echo", None),
+    "Flush": ("flush", None),
+    "Info": ("info", "info"),
+    "DeliverTx": ("deliver_tx", "deliver_tx"),
+    "CheckTx": ("check_tx", "check_tx"),
+    "Query": ("query", "query"),
+    "Commit": ("commit", "commit"),
+    "InitChain": ("init_chain", "init_chain"),
+    "BeginBlock": ("begin_block", "begin_block"),
+    "EndBlock": ("end_block", "end_block"),
+    "ListSnapshots": ("list_snapshots", "list_snapshots"),
+    "OfferSnapshot": ("offer_snapshot", "offer_snapshot"),
+    "LoadSnapshotChunk": ("load_snapshot_chunk", "load_snapshot_chunk"),
+    "ApplySnapshotChunk": ("apply_snapshot_chunk", "apply_snapshot_chunk"),
+}
+
+
+class ABCIGrpcServer:
+    """(grpc_server.go:13 NewServer) serves an Application over gRPC."""
+
+    def __init__(self, addr: str, app: Application, max_workers: int = 4):
+        self.app = app
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.bound_port = self._server.add_insecure_port(
+            addr.split("://", 1)[-1])
+
+    def _handler(self) -> grpc.GenericRpcHandler:
+        app = self.app
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                path = handler_call_details.method  # /SERVICE/Method
+                parts = path.rsplit("/", 2)
+                if len(parts) != 3 or parts[1] != SERVICE:
+                    return None
+                grpc_name = parts[2]
+                entry = _METHODS.get(grpc_name)
+                if entry is None:
+                    return None
+                key, app_attr = entry
+
+                def unary(req_bytes, context):
+                    if key == "echo":
+                        # RequestEcho{message=1} -> ResponseEcho{message=1}
+                        req = _dec_request_body("echo", req_bytes)
+                        return _enc_response_body("echo", req)
+                    if key == "flush":
+                        return _enc_response_body("flush", None)
+                    req = _dec_request_body(key, req_bytes)
+                    if key == "commit":
+                        resp = app.commit()
+                    else:
+                        resp = getattr(app, app_attr)(req)
+                    return _enc_response_body(key, resp)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+
+        return Handler()
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class GrpcClient(Client):
+    """(grpc_client.go:22) the sync ABCI Client over a gRPC channel."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._channel = grpc.insecure_channel(addr.split("://", 1)[-1])
+        self.timeout = timeout
+        self._calls = {}
+        for grpc_name, (key, _attr) in _METHODS.items():
+            self._calls[key] = self._channel.unary_unary(
+                f"/{SERVICE}/{grpc_name}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+
+    def _call(self, key: str, req) -> object:
+        body = _enc_request_body(key, req) if req is not None else b""
+        resp = self._calls[key](body, timeout=self.timeout)
+        return _dec_response_body(key, resp)
+
+    def echo(self, msg: str) -> str:
+        return self._call("echo", msg)
+
+    def flush(self) -> None:
+        self._call("flush", None)
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return self._call("info", req)
+
+    def init_chain(self, req):
+        return self._call("init_chain", req)
+
+    def query(self, req):
+        return self._call("query", req)
+
+    def check_tx(self, req):
+        return self._call("check_tx", req)
+
+    def begin_block(self, req):
+        return self._call("begin_block", req)
+
+    def deliver_tx(self, req):
+        return self._call("deliver_tx", req)
+
+    def end_block(self, req):
+        return self._call("end_block", req)
+
+    def commit(self) -> abci.ResponseCommit:
+        return self._call("commit", None)
+
+    def list_snapshots(self, req):
+        return self._call("list_snapshots", req)
+
+    def offer_snapshot(self, req):
+        return self._call("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req):
+        return self._call("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req):
+        return self._call("apply_snapshot_chunk", req)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -493,6 +493,17 @@ def _enc_response_body(method: str, resp: Any) -> bytes:
             w.bytes(6, resp.key)
         if resp.value:
             w.bytes(7, resp.value)
+        if resp.proof_ops:
+            # tendermint.crypto.ProofOps{repeated ProofOp ops=1};
+            # ProofOp{type=1 string, key=2, data=3}
+            ops = pw.Writer()
+            for op in resp.proof_ops:
+                opw = pw.Writer()
+                opw.string(1, op.type)
+                opw.bytes(2, op.key)
+                opw.bytes(3, op.data)
+                ops.message(1, opw.finish())
+            w.message(8, ops.finish())
         if resp.height:
             w.varint(9, resp.height)
         if resp.codespace:
@@ -580,12 +591,24 @@ def _dec_response_body(method: str, body: bytes) -> Any:
             validators=[_dec_validator_update(v) for v in f.get(2, [])],
             app_hash=get(3, b"") or b"")
     if method == "query":
+        proof_ops = None
+        if get(8) is not None:
+            from ..crypto.merkle import ProofOp
+
+            proof_ops = []
+            for opv in pw.fields_dict(get(8)).get(1, []):
+                opf = pw.fields_dict(opv)
+                proof_ops.append(ProofOp(
+                    type=(opf.get(1, [b""])[0] or b"").decode(),
+                    key=opf.get(2, [b""])[0] or b"",
+                    data=opf.get(3, [b""])[0] or b""))
         return abci.ResponseQuery(
             code=pw.varint_to_int64(get(1, 0) or 0),
             log=(get(3, b"") or b"").decode(),
             info=(get(4, b"") or b"").decode(),
             index=pw.varint_to_int64(get(5, 0) or 0),
             key=get(6, b"") or b"", value=get(7, b"") or b"",
+            proof_ops=proof_ops,
             height=pw.varint_to_int64(get(9, 0) or 0),
             codespace=(get(10, b"") or b"").decode())
     if method == "begin_block":

@@ -280,6 +280,195 @@ def cmd_version(args) -> int:
     return 0
 
 
+def _fetch_rpc(base_url: str, path: str):
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base_url}/{path}", timeout=10) as r:
+        return _json.load(r)
+
+
+def cmd_debug(args) -> int:
+    """(cmd/tendermint/commands/debug/{dump,kill}.go) capture a diagnostic
+    bundle from a RUNNING node over RPC + its home dir: status, net_info,
+    dump_consensus_state, consensus_state, config, WAL tail. ``debug kill``
+    captures the bundle and then SIGKILLs the node."""
+    import shutil
+    import signal as _signal
+    import time as _time
+
+    cfg = Config.load(args.home)
+    rpc = args.rpc_laddr or cfg.rpc.laddr
+    base = "http://" + rpc.split("://", 1)[-1]
+    out = args.output_dir or os.path.join(
+        args.home, f"debug-{int(_time.time())}")
+    os.makedirs(out, exist_ok=True)
+
+    for route in ("status", "net_info", "consensus_state",
+                  "dump_consensus_state"):
+        try:
+            doc = _fetch_rpc(base, route)
+            with open(os.path.join(out, f"{route}.json"), "w") as f:
+                json.dump(doc, f, indent=2)
+        except Exception as e:
+            with open(os.path.join(out, f"{route}.err"), "w") as f:
+                f.write(str(e))
+
+    # config + WAL tail from the home dir
+    cfg_file = os.path.join(args.home, cfgmod.CONFIG_DIR, "config.toml")
+    if os.path.exists(cfg_file):
+        shutil.copy(cfg_file, os.path.join(out, "config.toml"))
+    try:
+        from .consensus.wal import WAL
+
+        wal = WAL(cfg.wal_file())
+        msgs = list(wal.iter_messages())[-200:]
+        with open(os.path.join(out, "wal_tail.jsonl"), "w") as f:
+            for m in msgs:
+                f.write(json.dumps({"type": m.type, "time_ns": m.time_ns,
+                                    "data": m.data}, default=str) + "\n")
+    except Exception as e:
+        with open(os.path.join(out, "wal_tail.err"), "w") as f:
+            f.write(str(e))
+
+    print(f"wrote debug bundle to {out}")
+    if args.action == "kill":
+        pid = args.pid
+        if not pid:
+            print("debug kill: --pid required", file=sys.stderr)
+            return 1
+        os.kill(pid, _signal.SIGKILL)
+        print(f"killed pid {pid}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """(cmd/tendermint/commands/replay.go, consensus/replay_file.go) rebuild
+    the node from its home dir — the ABCI handshake replays stored blocks
+    into the app (consensus/replay.go ReplayBlocks) — then feed the WAL tail
+    for the in-flight height through the real consensus state machine,
+    printing each message; ``--console`` pauses between messages."""
+    from .consensus.replay import _replay_message
+    from .node import Node
+
+    logging.basicConfig(level=logging.WARNING)
+    cfg = Config.load(args.home)
+    cfg.p2p.laddr = ""      # replay is offline: no listeners
+    cfg.rpc.laddr = ""
+    node = Node.default(cfg)  # handshake replay of stored blocks happens here
+    cs = node.consensus_state
+    height = cs.rs.height
+    print(f"handshake replayed chain to height {height - 1}; "
+          f"replaying WAL for in-flight height {height}")
+    count = 0
+    cs._replay_mode = True
+    try:
+        for m in cs.wal.messages_after_end_height(height - 1):
+            count += 1
+            summary = {k: v for k, v in (m.data or {}).items()
+                       if k in ("height", "round", "step", "type",
+                                "duration_ns")}
+            print(f"#{count:<5} {m.type:<12} {summary}")
+            if args.console:
+                try:
+                    if input("replay> ").strip() in ("q", "quit"):
+                        break
+                except EOFError:
+                    break
+            try:
+                _replay_message(cs, m)
+            except Exception as e:
+                print(f"  !! replay error: {e}")
+    finally:
+        cs._replay_mode = False
+    rs = cs.rs
+    print(f"replayed {count} WAL messages; round state now "
+          f"{rs.height}/{rs.round}/{int(rs.step)}")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """(cmd compact-db; reference compacts goleveldb) VACUUM every sqlite
+    store under the data dir."""
+    import sqlite3
+
+    cfg = Config.load(args.home)
+    n = 0
+    for name in sorted(os.listdir(cfg.db_dir())):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(cfg.db_dir(), name)
+        before = os.path.getsize(path)
+        con = sqlite3.connect(path)
+        con.execute("VACUUM")
+        con.close()
+        after = os.path.getsize(path)
+        print(f"{name}: {before} -> {after} bytes")
+        n += 1
+    if n == 0:
+        print("no .db files found (mem backend?)")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """(cmd reindex-event) rebuild the tx index from stored blocks + their
+    persisted ABCI responses (state/txindex kv sink)."""
+    from .libs.db import SQLiteDB
+    from .state.store import StateStore
+    from .state.txindex import KVTxIndexer, TxResult
+    from .store import BlockStore
+
+    cfg = Config.load(args.home)
+    dbdir = cfg.db_dir()
+    block_store = BlockStore(SQLiteDB(os.path.join(dbdir, "blockstore.db")))
+    state_store = StateStore(SQLiteDB(os.path.join(dbdir, "state.db")))
+    indexer = SQLiteDB(os.path.join(dbdir, "txindex.db"))
+    txi = KVTxIndexer(indexer)
+    count = 0
+    for h in range(block_store.base(), block_store.height() + 1):
+        block = block_store.load_block(h)
+        resps = state_store.load_abci_responses(h)
+        if block is None or resps is None:
+            continue
+        for i, tx in enumerate(block.data.txs):
+            r = resps.deliver_txs[i] if i < len(resps.deliver_txs) else None
+            txi.index(TxResult(
+                height=h, index=i, tx=tx,
+                code=getattr(r, "code", 0), data=getattr(r, "data", b""),
+                log=getattr(r, "log", ""),
+                gas_wanted=getattr(r, "gas_wanted", 0),
+                gas_used=getattr(r, "gas_used", 0),
+                events={}))
+            count += 1
+    print(f"reindexed {count} txs over heights "
+          f"{block_store.base()}..{block_store.height()}")
+    return 0
+
+
+def cmd_signer(args) -> int:
+    """Remote signer process: serves a FilePV to a node over the privval
+    SecretConnection link (the tmkms role; reference privval/signer_server.go).
+    Runs until SIGINT."""
+    import signal as _signal
+    import threading
+
+    from .privval.file_pv import FilePV
+    from .privval.signer import SignerServer
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname).1s %(message)s")
+    pv = FilePV.load(args.key_file, args.state_file)
+    host, _, port = args.addr.rpartition("://")[-1].rpartition(":")
+    server = SignerServer(pv, args.chain_id, (host or "127.0.0.1", int(port)))
+    server.start()
+    stop = threading.Event()
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        _signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tmtpu",
                                 description="tendermint-tpu node CLI")
@@ -318,7 +507,34 @@ def main(argv=None) -> int:
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.set_defaults(fn=cmd_light)
 
-    for name, fn in [("rollback", cmd_rollback),
+    sp = sub.add_parser("debug", help="capture a diagnostic bundle "
+                                      "(dump) or capture-then-kill")
+    sp.add_argument("action", choices=("dump", "kill"))
+    sp.add_argument("--output-dir", dest="output_dir", default="")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--pid", type=int, default=0,
+                    help="node pid (required for kill)")
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("replay", help="replay blocks + WAL through the "
+                                       "state machine (offline)")
+    sp.set_defaults(fn=cmd_replay, console=False)
+
+    sp = sub.add_parser("replay-console",
+                        help="interactive step-by-step WAL replay")
+    sp.set_defaults(fn=cmd_replay, console=True)
+
+    sp = sub.add_parser("signer", help="remote privval signer process")
+    sp.add_argument("--key-file", dest="key_file", required=True)
+    sp.add_argument("--state-file", dest="state_file", required=True)
+    sp.add_argument("--chain-id", dest="chain_id", required=True)
+    sp.add_argument("--addr", required=True,
+                    help="node's priv_validator_laddr to dial, host:port")
+    sp.set_defaults(fn=cmd_signer)
+
+    for name, fn in [("compact-db", cmd_compact_db),
+                     ("reindex-event", cmd_reindex_event),
+                     ("rollback", cmd_rollback),
                      ("gen-node-key", cmd_gen_node_key),
                      ("show-node-id", cmd_show_node_id),
                      ("gen-validator", cmd_gen_validator),

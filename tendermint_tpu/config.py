@@ -44,7 +44,7 @@ class BaseConfig:
     # SecretConnection handshake on priv_validator_laddr pins it
     priv_validator_signer_key: str = ""
     node_key_file: str = "config/node_key.json"
-    abci: str = "local"              # local | socket
+    abci: str = "local"              # local | socket | grpc
     proxy_app: str = "kvstore"       # app name or tcp://host:port when socket
     filter_peers: bool = False
 
@@ -212,7 +212,7 @@ class Config:
     def validate_basic(self) -> None:
         if self.base.db_backend not in ("sqlite", "mem"):
             raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
-        if self.base.abci not in ("local", "socket"):
+        if self.base.abci not in ("local", "socket", "grpc"):
             raise ValueError(f"unknown abci mode {self.base.abci!r}")
         if self.mempool.size <= 0:
             raise ValueError("mempool.size must be positive")

@@ -187,12 +187,45 @@ class ConsensusState:
         if vals is not None:
             m.validators.set(vals.size())
             m.validators_power.set(vals.total_voting_power())
-        if block.last_commit is not None:
-            missing = sum(1 for cs in block.last_commit.signatures
-                          if cs.absent())
+        if block.last_commit is not None and self.rs.last_validators is not None:
+            lvals = self.rs.last_validators
+            missing = missing_power = 0
+            our_addr = (self.priv_validator.get_pub_key().address()
+                        if self.priv_validator is not None else None)
+            for i, cs in enumerate(block.last_commit.signatures):
+                _, val = lvals.get_by_index(i)
+                if cs.absent():
+                    missing += 1
+                    if val is not None:
+                        missing_power += val.voting_power
+                        if our_addr is not None and val.address == our_addr:
+                            m.validator_missed_blocks.inc()
+                elif (val is not None and our_addr is not None
+                        and val.address == our_addr):
+                    m.validator_last_signed_height.set(
+                        block.header.height - 1)
             m.missing_validators.set(missing)
+            m.missing_validators_power.set(missing_power)
+        if vals is not None and self.priv_validator is not None:
+            _, us = vals.get_by_address(
+                self.priv_validator.get_pub_key().address())
+            m.validator_power.set(us.voting_power if us is not None else 0)
+        m.committed_height.set(block.header.height)
+        m.latest_block_height.set(block.header.height)
         m.num_txs.set(len(block.data.txs))
+        # block size from the part set already in hand — re-encoding a
+        # potentially huge block inside the single-writer loop just for a
+        # gauge would delay the next height
+        parts = self.rs.proposal_block_parts
+        if parts is not None:
+            m.block_size_bytes.set(parts.byte_size)
         m.total_txs.inc(len(block.data.txs))
+        byz_power = 0
+        for ev in block.evidence:
+            for v in getattr(ev, "byzantine_validators", []) or []:
+                byz_power += getattr(v, "voting_power", 0)
+        m.byzantine_validators.set(len(block.evidence))
+        m.byzantine_validators_power.set(byz_power)
         if self.state.last_block_time_ns:
             m.block_interval_seconds.observe(
                 max(0.0, (block.header.time_ns - self.state.last_block_time_ns)

@@ -140,4 +140,14 @@ def pubkey_from_type_and_bytes(type_name: str, b: bytes) -> PubKey:
         if len(b) != 32:
             raise ValueError(f"ed25519 pubkey must be 32 bytes, got {len(b)}")
         return Ed25519PubKey(b)
+    if type_name == "secp256k1":
+        from .secp256k1 import Secp256k1PubKey
+
+        return Secp256k1PubKey(b)
+    if type_name == "sr25519":
+        from .sr25519 import Sr25519PubKey
+
+        if len(b) != 32:
+            raise ValueError(f"sr25519 pubkey must be 32 bytes, got {len(b)}")
+        return Sr25519PubKey(b)
     raise ValueError(f"unknown pubkey type {type_name!r}")

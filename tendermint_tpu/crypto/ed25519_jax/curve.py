@@ -138,17 +138,18 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     v7 = F.mul(F.sqr(v3), v)
     x = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
     vxx = F.mul(v, F.sqr(x))
-    fvxx = F.freeze(vxx)  # shared between both equality probes
-    ok_direct = jnp.all(fvxx == F.freeze(u), axis=0)
-    ok_flip = jnp.all(fvxx == F.freeze(F.neg(u)), axis=0)
+    # vxx == ±u probed as (vxx ∓ u) == 0: two freezes instead of three
+    ok_direct = F.is_zero(F.sub(vxx, u))
+    ok_flip = F.is_zero(F.add(vxx, u))
     x = jnp.where(ok_direct, x, F.mul(x, F.const(SQRT_M1_INT, nb)))
     on_curve = ok_direct | ok_flip
 
-    x_is_zero = F.is_zero(x)
+    # one freeze of x yields both the zero test and the parity bit
+    fx = F.freeze(x)
+    x_is_zero = jnp.all(fx == 0, axis=0)
     sign = sign.astype(jnp.uint32)
     ok = y_ok & on_curve & ~(x_is_zero & (sign == 1))
-    # fix parity
-    flip = F.parity(x) != sign
+    flip = (fx[0] & 1) != sign
     x = jnp.where(flip, F.neg(x), x)
     pt = Point(x, y_limbs, jnp.zeros_like(x).at[0].set(1), F.mul(x, y_limbs))
     return pt, ok

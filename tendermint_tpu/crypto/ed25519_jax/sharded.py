@@ -11,10 +11,10 @@ the reference's per-node scalar tally loop (reference
 types/vote_set.go:449, types/validator_set.go:667).
 
 The tally is EXACT for int64 voting powers: each power is split host-side
-into five 15-bit limbs (2^75 > MaxTotalVotingPower = 2^60 headroom), the
-per-limb sums ride the psum as int32 (safe for up to 2^16 signatures
-globally: 2^15 · 2^16 = 2^31), and the host recombines
-``Σ psum_j · 2^15j`` in Python ints.
+into eight 8-bit limbs (2^64 covers MaxTotalVotingPower = 2^60), the
+per-limb sums ride the psum as int32 (safe for up to 2^22 signatures
+globally: 255 · 2^22 < 2^31 — commit scale, 10k+ validators, with 400x
+headroom), and the host recombines ``Σ psum_j · 2^8j`` in Python ints.
 """
 
 from __future__ import annotations
@@ -35,8 +35,9 @@ BLOCK_SPEC = P(None, None, AXIS, None)  # (NBLK, 32, B, 128): shard sublanes
 WORD_SPEC = P(None, AXIS, None)         # (8, B, 128)
 FLAG_SPEC = P(AXIS, None)               # (B, 128)
 
-POWER_LIMBS = 5                          # 5 x 15-bit limbs cover int64 powers
-MAX_EXACT_SIGS = 1 << 16                 # int32-safe limb-sum bound
+POWER_LIMB_BITS = 8
+POWER_LIMBS = 8                          # 8 x 8-bit limbs cover int64 powers
+MAX_EXACT_SIGS = 1 << 22                 # int32-safe limb-sum bound (255·2^22 < 2^31)
 
 
 def make_mesh(n_devices: int) -> Mesh:
@@ -58,7 +59,7 @@ def _sharded_step(mesh: Mesh):
 
     def full_step(blocks, nblk, s_words, power_limbs):
         verdict = _verify_kernel.__wrapped__(blocks, nblk, s_words)
-        # (5, B, 128) int32 limb planes; zero out rejected signatures
+        # (8, B, 128) int32 8-bit limb planes; zero out rejected signatures
         masked = jnp.where(verdict[None], power_limbs, 0)
         local = jnp.sum(masked, axis=(1, 2))          # (5,) int32
         total_limbs = jax.lax.psum(local, axis_name=AXIS)
@@ -76,11 +77,13 @@ def _sharded_step(mesh: Mesh):
 
 
 def _power_limbs(powers: np.ndarray, pad: int, b: int) -> np.ndarray:
-    """(n,) int64 -> (5, B, 128) int32 planes of 15-bit limbs."""
+    """(n,) int64 -> (8, B, 128) int32 planes of 8-bit limbs."""
     out = np.zeros((POWER_LIMBS, pad), dtype=np.int32)
     p = powers.astype(np.uint64)
     for j in range(POWER_LIMBS):
-        out[j, : len(powers)] = ((p >> (15 * j)) & 0x7FFF).astype(np.int32)
+        out[j, : len(powers)] = (
+            (p >> (POWER_LIMB_BITS * j)) & ((1 << POWER_LIMB_BITS) - 1)
+        ).astype(np.int32)
     return out.reshape(POWER_LIMBS, b, LANE)
 
 
@@ -97,7 +100,7 @@ def batch_verify_sharded(
     The batch pads to a multiple of ``n_devices * 128`` so the sublane axis
     divides evenly across the mesh. The returned tally is the exact int64
     sum of ``powers`` over accepted signatures, computed with a device-side
-    psum of 15-bit limb planes (see module docstring).
+    psum of 8-bit limb planes (see module docstring).
     """
     if mesh is None:
         mesh = make_mesh(n_devices or len(jax.devices()))
@@ -131,5 +134,5 @@ def batch_verify_sharded(
     verdict, total_limbs = _sharded_step(mesh)(*args)
     verdict = np.asarray(verdict).reshape(-1)[:n] & ok
     tl = np.asarray(total_limbs)
-    total = sum(int(tl[j]) << (15 * j) for j in range(POWER_LIMBS))
+    total = sum(int(tl[j]) << (POWER_LIMB_BITS * j) for j in range(POWER_LIMBS))
     return verdict, total

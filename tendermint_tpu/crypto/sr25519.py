@@ -1,0 +1,256 @@
+"""sr25519 (schnorrkel/ristretto255) — the third consensus key type
+(reference crypto/sr25519/pubkey.go:10, privkey.go via ChainSafe/go-schnorrkel).
+
+Host-side pure Python, reusing the edwards25519 group from crypto/ed25519
+and the merlin transcript from libs/merlin. Scalar verification never rides
+the TPU kernel (SURVEY §2.3: "keep scalar on host").
+
+Pieces, matching go-schnorrkel exactly:
+
+* ristretto255 encode/decode (RFC 9496 §4.3) over edwards25519;
+* mini-secret expansion ``ExpandEd25519``: SHA-512(mini), clamp, divide the
+  key scalar by the cofactor (schnorrkel's ed25519-compat expansion);
+* signing context: merlin ``Transcript("SigningContext")``,
+  ``append("", ctx)``, ``append("sign-bytes", msg)``;
+* sign/verify transcript: ``proto-name=Schnorr-sig``, ``sign:pk``,
+  ``sign:R``, challenge scalar from 64 bytes of ``sign:c`` reduced mod L;
+* signature wire form: 32-byte ristretto R || 32-byte scalar s with bit 7
+  of byte 63 set (the schnorrkel "not ed25519" marker).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Tuple
+
+from ..libs.merlin import Transcript
+from . import PrivKey, PubKey, address_hash
+from .ed25519 import D as _D, L, P, _IDENT, _pt_add, _pt_mul, B as _B
+
+SEED_SIZE = 32
+PUBKEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+_SQRT_M1 = pow(2, (P - 1) // 4, P)
+_INVSQRT_A_MINUS_D = None  # computed lazily below
+_SQRT_AD_MINUS_ONE = None
+_ONE_MINUS_D_SQ = None
+_D_MINUS_ONE_SQ = None
+
+
+def _init_consts() -> None:
+    global _INVSQRT_A_MINUS_D, _SQRT_AD_MINUS_ONE, _ONE_MINUS_D_SQ, _D_MINUS_ONE_SQ
+    if _INVSQRT_A_MINUS_D is not None:
+        return
+    a = P - 1  # a = -1
+    ok, inv_s = _sqrt_ratio_m1(1, (a - _D) % P)
+    assert ok
+    _INVSQRT_A_MINUS_D = inv_s
+    ok, s = _sqrt_ratio_m1((a * _D - 1) % P, 1)
+    assert ok
+    _SQRT_AD_MINUS_ONE = s
+    _ONE_MINUS_D_SQ = (1 - _D * _D) % P
+    _D_MINUS_ONE_SQ = ((_D - 1) * (_D - 1)) % P
+
+
+def _sqrt_ratio_m1(u: int, v: int) -> Tuple[bool, int]:
+    """(RFC 9496 §4.2 SQRT_RATIO_M1) -> (was_square, sqrt(u/v) or related)."""
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    r = (u * v3 % P) * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    correct_sign = check == u % P
+    flipped_sign = check == (-u) % P
+    flipped_sign_i = check == ((-u) % P) * _SQRT_M1 % P
+    if flipped_sign or flipped_sign_i:
+        r = r * _SQRT_M1 % P
+    if r % 2 == 1:  # use the non-negative (even) root
+        r = P - r
+    return correct_sign or flipped_sign, r
+
+
+def ristretto_decode(b: bytes) -> Optional[Tuple[int, int, int, int]]:
+    """(RFC 9496 §4.3.1 Decode) 32 bytes -> extended point, or None."""
+    _init_consts()
+    if len(b) != 32:
+        return None
+    s = int.from_bytes(b, "little")
+    if s >= P or s % 2 == 1:  # canonical and non-negative
+        return None
+    ss = s * s % P
+    u1 = (1 - ss) % P
+    u2 = (1 + ss) % P
+    u2_sqr = u2 * u2 % P
+    v = (-(_D * u1 % P) * u1 % P - u2_sqr) % P
+    ok, invsqrt = _sqrt_ratio_m1(1, v * u2_sqr % P)
+    if not ok:
+        return None
+    den_x = invsqrt * u2 % P
+    den_y = invsqrt * den_x % P * v % P
+    x = 2 * s % P * den_x % P
+    if x % 2 == 1:
+        x = P - x
+    y = u1 * den_y % P
+    t = x * y % P
+    if t % 2 == 1 or y == 0:
+        return None
+    return (x, y, 1, t)
+
+
+def ristretto_encode(pt: Tuple[int, int, int, int]) -> bytes:
+    """(RFC 9496 §4.3.2 Encode) extended point -> 32 bytes."""
+    _init_consts()
+    x0, y0, z0, t0 = pt
+    u1 = (z0 + y0) * (z0 - y0) % P
+    u2 = x0 * y0 % P
+    _, invsqrt = _sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+    den1 = invsqrt * u1 % P
+    den2 = invsqrt * u2 % P
+    z_inv = den1 * den2 % P * t0 % P
+    ix0 = x0 * _SQRT_M1 % P
+    iy0 = y0 * _SQRT_M1 % P
+    enchanted_denominator = den1 * _INVSQRT_A_MINUS_D % P
+    rotate = (t0 * z_inv % P) % 2 == 1
+    if rotate:
+        x, y = iy0, ix0
+        den_inv = enchanted_denominator
+    else:
+        x, y = x0, y0
+        den_inv = den2
+    if (x * z_inv % P) % 2 == 1:
+        y = P - y
+    s = (z0 - y) * den_inv % P
+    if s % 2 == 1:
+        s = P - s
+    return s.to_bytes(32, "little")
+
+
+# -- scalars & transcripts ---------------------------------------------------
+
+def _challenge_scalar(t: Transcript, label: bytes) -> int:
+    return int.from_bytes(t.challenge_bytes(label, 64), "little") % L
+
+
+def signing_context(ctx: bytes, msg: bytes) -> Transcript:
+    t = Transcript(b"SigningContext")
+    t.append_message(b"", ctx)
+    t.append_message(b"sign-bytes", msg)
+    return t
+
+
+def expand_ed25519(mini: bytes) -> Tuple[int, bytes]:
+    """(schnorrkel MiniSecretKey.ExpandEd25519) -> (key scalar, 32B nonce).
+
+    Clamps like ed25519 then divides by the cofactor (the scalar is stored
+    //8; schnorrkel multiplies by the untwisted basepoint directly)."""
+    h = hashlib.sha512(mini).digest()
+    key = bytearray(h[:32])
+    key[0] &= 248
+    key[31] &= 63
+    key[31] |= 64
+    scalar = int.from_bytes(bytes(key), "little") >> 3
+    return scalar % L, h[32:]
+
+
+# -- sign / verify -----------------------------------------------------------
+
+def pubkey_from_mini(mini: bytes) -> bytes:
+    scalar, _ = expand_ed25519(mini)
+    return ristretto_encode(_pt_mul(scalar, (_B[0], _B[1], 1, _B[0] * _B[1] % P)))
+
+
+def sign(mini: bytes, msg: bytes, ctx: bytes = b"") -> bytes:
+    scalar, nonce = expand_ed25519(mini)
+    pub = ristretto_encode(_pt_mul(scalar, (_B[0], _B[1], 1, _B[0] * _B[1] % P)))
+    t = signing_context(ctx, msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    # witness scalar: schnorrkel draws from a transcript-rng over the nonce;
+    # ANY high-entropy r yields a valid signature — use hash(nonce, msg, rnd)
+    r = int.from_bytes(
+        hashlib.sha512(nonce + msg + os.urandom(32)).digest(), "little") % L
+    R = ristretto_encode(_pt_mul(r, (_B[0], _B[1], 1, _B[0] * _B[1] % P)))
+    t.append_message(b"sign:R", R)
+    k = _challenge_scalar(t, b"sign:c")
+    s = (k * scalar + r) % L
+    sig = bytearray(R + s.to_bytes(32, "little"))
+    sig[63] |= 128  # schnorrkel marker bit
+    return bytes(sig)
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes, ctx: bytes = b"") -> bool:
+    if len(sig) != SIGNATURE_SIZE or len(pub) != PUBKEY_SIZE:
+        return False
+    if not sig[63] & 128:  # marker bit required (Signature.Decode)
+        return False
+    R_bytes = sig[:32]
+    s_arr = bytearray(sig[32:])
+    s_arr[31] &= 127
+    s = int.from_bytes(bytes(s_arr), "little")
+    if s >= L:
+        return False
+    A = ristretto_decode(pub)
+    R = ristretto_decode(R_bytes)
+    if A is None or R is None:
+        return False
+    t = signing_context(ctx, msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    t.append_message(b"sign:R", R_bytes)
+    k = _challenge_scalar(t, b"sign:c")
+    # R' = s*B - k*A; ristretto equality = encoding equality
+    base = (_B[0], _B[1], 1, _B[0] * _B[1] % P)
+    sB = _pt_mul(s, base)
+    negA = ((P - A[0]) % P, A[1], A[2], (P - A[3]) % P)
+    Rp = _pt_add(sB, _pt_mul(k, negA))
+    return ristretto_encode(Rp) == R_bytes
+
+
+# -- key types (crypto.PubKey/PrivKey seam) ----------------------------------
+
+class Sr25519PubKey(PubKey):
+    TYPE = "sr25519"
+
+    def __init__(self, raw: bytes):
+        self._raw = bytes(raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def address(self) -> bytes:
+        return address_hash(self._raw)
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        try:
+            return verify(self._raw, msg, sig)
+        except Exception:
+            return False
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Sr25519PubKey) and other._raw == self._raw
+
+    def __repr__(self) -> str:
+        return f"PubKeySr25519{{{self._raw.hex().upper()}}}"
+
+
+class Sr25519PrivKey(PrivKey):
+    TYPE = "sr25519"
+
+    def __init__(self, mini: bytes):
+        if len(mini) != SEED_SIZE:
+            raise ValueError("sr25519 private key must be a 32-byte mini secret")
+        self._mini = bytes(mini)
+
+    @staticmethod
+    def generate(seed: Optional[bytes] = None) -> "Sr25519PrivKey":
+        return Sr25519PrivKey(seed if seed is not None else os.urandom(32))
+
+    def bytes(self) -> bytes:
+        return self._mini
+
+    def sign(self, msg: bytes) -> bytes:
+        return sign(self._mini, msg)
+
+    def pub_key(self) -> Sr25519PubKey:
+        return Sr25519PubKey(pubkey_from_mini(self._mini))

@@ -1,0 +1,11 @@
+"""Manifest-driven end-to-end testnets (reference test/e2e/).
+
+A TOML manifest describes an N-node network — sync modes, mempool version,
+privval transport, perturbations, byzantine misbehaviors — and the runner
+drives it through setup/start/load/perturb/wait/test stages with post-run
+invariant checks over RPC (reference test/e2e/pkg/manifest.go:11,
+test/e2e/runner/main.go, test/e2e/runner/perturb.go:28-66).
+"""
+
+from .manifest import Manifest, NodeManifest  # noqa: F401
+from .runner import Runner  # noqa: F401

@@ -1,0 +1,116 @@
+"""E2E testnet manifest (reference test/e2e/pkg/manifest.go:11).
+
+TOML shape:
+
+    chain_id = "e2e-net"
+    initial_height = 1
+    load_tx_rate = 2            # txs/sec during the load stage
+    wait_blocks = 6             # blocks to wait after perturbations
+
+    [validators]                # name -> voting power (defaults: all 4 @ 10)
+    validator0 = 10
+
+    [node.validator0]
+    mode = "validator"          # validator | full
+    mempool_version = "v1"      # v0 | v1
+    fast_sync = true
+    state_sync = false
+    privval = "file"            # file | tcp (remote signer over SecretConn)
+    start_at = 0                # join the net after this height (0 = launch)
+    perturb = ["kill"]          # kill | pause | restart | disconnect
+    [node.validator0.misbehaviors]
+    3 = "double-prevote"        # height -> misbehavior (maverick hooks)
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class NodeManifest:
+    name: str
+    mode: str = "validator"            # validator | full
+    mempool_version: str = "v0"
+    fast_sync: bool = True
+    state_sync: bool = False
+    privval: str = "file"              # file | tcp
+    start_at: int = 0                  # 0 = start with the net
+    perturb: List[str] = field(default_factory=list)
+    misbehaviors: Dict[int, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.mode not in ("validator", "full"):
+            raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
+        if self.mempool_version not in ("v0", "v1"):
+            raise ValueError(
+                f"{self.name}: unknown mempool version {self.mempool_version!r}")
+        if self.privval not in ("file", "tcp"):
+            raise ValueError(f"{self.name}: unknown privval {self.privval!r}")
+        for p in self.perturb:
+            if p not in ("kill", "pause", "restart", "disconnect"):
+                raise ValueError(f"{self.name}: unknown perturbation {p!r}")
+        if self.state_sync and self.start_at == 0:
+            raise ValueError(
+                f"{self.name}: state_sync nodes must join later (start_at > 0)")
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-net"
+    initial_height: int = 1
+    load_tx_rate: int = 2
+    wait_blocks: int = 6
+    validators: Dict[str, int] = field(default_factory=dict)
+    nodes: List[NodeManifest] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        return cls.from_doc(doc)
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Manifest":
+        nodes = []
+        for name, nd in doc.get("node", {}).items():
+            nodes.append(NodeManifest(
+                name=name,
+                mode=nd.get("mode", "validator"),
+                mempool_version=nd.get("mempool_version", "v0"),
+                fast_sync=nd.get("fast_sync", True),
+                state_sync=nd.get("state_sync", False),
+                privval=nd.get("privval", "file"),
+                start_at=int(nd.get("start_at", 0)),
+                perturb=list(nd.get("perturb", [])),
+                misbehaviors={int(h): m
+                              for h, m in nd.get("misbehaviors", {}).items()},
+            ))
+        m = cls(
+            chain_id=doc.get("chain_id", "e2e-net"),
+            initial_height=int(doc.get("initial_height", 1)),
+            load_tx_rate=int(doc.get("load_tx_rate", 2)),
+            wait_blocks=int(doc.get("wait_blocks", 6)),
+            validators={k: int(v) for k, v in doc.get("validators", {}).items()},
+            nodes=nodes,
+        )
+        m.validate()
+        return m
+
+    def validate(self) -> None:
+        if not self.nodes:
+            raise ValueError("manifest has no nodes")
+        names = [n.name for n in self.nodes]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate node names")
+        n_validators = sum(1 for n in self.nodes if n.mode == "validator")
+        if n_validators < 1:
+            raise ValueError("need at least one validator")
+        for n in self.nodes:
+            n.validate()
+        launch_validators = [n for n in self.nodes
+                             if n.mode == "validator" and n.start_at == 0]
+        if not launch_validators:
+            raise ValueError("need at least one validator at genesis")

@@ -1,0 +1,402 @@
+"""E2E testnet runner (reference test/e2e/runner/main.go stages:
+setup → start → load → perturb → wait → test → stop).
+
+Drives subprocess nodes (python -m tendermint_tpu.cmd start) generated from
+a Manifest. Perturbations follow test/e2e/runner/perturb.go:28-66: kill
+(SIGKILL + relaunch), restart (SIGTERM + relaunch), pause (SIGSTOP/SIGCONT),
+disconnect (approximated with a long SIGSTOP so peers drop and re-dial —
+subprocess nets have no network namespace to unplug).
+
+Invariants after the run (reference test/e2e/tests/): all nodes reach a
+common height, app hashes agree at sampled heights, txs injected during the
+load stage are queryable everywhere, and byzantine double-votes surface as
+committed DuplicateVoteEvidence.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..config import CONFIG_DIR, DATA_DIR, Config
+from .manifest import Manifest, NodeManifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class E2EError(Exception):
+    pass
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, root: str, base_port: int = 29000):
+        self.m = manifest
+        self.root = root
+        self.base_port = base_port
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.signers: Dict[str, subprocess.Popen] = {}
+        self.configs: Dict[str, Config] = {}
+        self.node_ids: Dict[str, str] = {}
+        self.loaded_txs: List[bytes] = []
+        self._log = open(os.path.join(root, "runner.log"), "w") \
+            if os.path.isdir(root) else None
+
+    # -- ports ---------------------------------------------------------------
+
+    def _ports(self, i: int):
+        base = self.base_port + 4 * i
+        return base, base + 1, base + 2  # p2p, rpc, privval
+
+    def _rpc_port(self, name: str) -> int:
+        idx = [n.name for n in self.m.nodes].index(name)
+        return self._ports(idx)[1]
+
+    # -- stages --------------------------------------------------------------
+
+    def setup(self) -> None:
+        """Generate per-node homes, one shared genesis, manifest knobs
+        applied to each config."""
+        from ..p2p import NodeKey
+        from ..privval.file_pv import FilePV
+        from ..types import GenesisDoc, GenesisValidator
+
+        os.makedirs(self.root, exist_ok=True)
+        pvs: Dict[str, FilePV] = {}
+        for i, nm in enumerate(self.m.nodes):
+            home = os.path.join(self.root, nm.name)
+            p2p, rpc, pvp = self._ports(i)
+            cfg = Config(root_dir=home)
+            cfg.base.chain_id = self.m.chain_id
+            cfg.base.moniker = nm.name
+            cfg.base.proxy_app = "kvstore-snapshot"
+            cfg.base.fast_sync = nm.fast_sync
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc}"
+            cfg.mempool.version = nm.mempool_version
+            if nm.privval == "tcp":
+                cfg.base.priv_validator_laddr = f"tcp://127.0.0.1:{pvp}"
+            if nm.state_sync:
+                cfg.statesync.enable = True
+                cfg.statesync.discovery_time = 3.0
+            os.makedirs(os.path.join(home, CONFIG_DIR), exist_ok=True)
+            os.makedirs(os.path.join(home, DATA_DIR), exist_ok=True)
+            pv = FilePV.generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+            pv.save()
+            pvs[nm.name] = pv
+            nk = NodeKey.load_or_gen(cfg.node_key_file())
+            self.node_ids[nm.name] = nk.id
+            self.configs[nm.name] = cfg
+
+        powers = self.m.validators or {
+            nm.name: 10 for nm in self.m.nodes if nm.mode == "validator"}
+        genesis = GenesisDoc(
+            chain_id=self.m.chain_id,
+            genesis_time_ns=time.time_ns(),
+            initial_height=self.m.initial_height,
+            validators=[GenesisValidator(pvs[name].get_pub_key(), power)
+                        for name, power in powers.items()
+                        if name in pvs],
+        )
+        for i, nm in enumerate(self.m.nodes):
+            cfg = self.configs[nm.name]
+            peers = ",".join(
+                f"{self.node_ids[other.name]}@127.0.0.1:{self._ports(j)[0]}"
+                for j, other in enumerate(self.m.nodes)
+                if other.name != nm.name)
+            cfg.p2p.persistent_peers = peers
+            genesis.save_as(cfg.genesis_file())
+            cfg.save()
+
+    def _env(self, nm: NodeManifest) -> dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        if nm.misbehaviors:
+            env["TMTPU_MISBEHAVIORS"] = ",".join(
+                f"{h}:{b}" for h, b in sorted(nm.misbehaviors.items()))
+            env["TMTPU_UNSAFE_PV"] = "1"
+        return env
+
+    def _launch(self, nm: NodeManifest) -> None:
+        cfg = self.configs[nm.name]
+        env = self._env(nm)
+        if nm.privval == "tcp" and nm.name not in self.signers:
+            pvp = cfg.base.priv_validator_laddr.rpartition(":")[-1]
+            self.signers[nm.name] = subprocess.Popen(
+                [sys.executable, "-m", "tendermint_tpu.cmd", "signer",
+                 "--key-file", cfg.priv_validator_key_file(),
+                 "--state-file", cfg.priv_validator_state_file(),
+                 "--chain-id", self.m.chain_id,
+                 "--addr", f"127.0.0.1:{pvp}"],
+                env=env, cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        log = open(os.path.join(self.root, f"{nm.name}.log"), "a")
+        self.procs[nm.name] = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd",
+             "--home", cfg.root_dir, "start", "--log-level", "warning"],
+            env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT)
+
+    def start(self) -> None:
+        """Launch genesis nodes; late joiners wait for their start_at."""
+        for nm in self.m.nodes:
+            if nm.start_at == 0:
+                self._launch(nm)
+        self.wait_for_height(max(2, self.m.initial_height + 1),
+                             nodes=[n.name for n in self.m.nodes
+                                    if n.start_at == 0])
+
+    def start_late_joiners(self) -> None:
+        for nm in self.m.nodes:
+            if nm.start_at == 0 or nm.name in self.procs:
+                continue
+            self.wait_for_height(nm.start_at)
+            if nm.state_sync:
+                self._point_state_sync(nm)
+            self._launch(nm)
+
+    def _point_state_sync(self, nm: NodeManifest) -> None:
+        """Fill rpc_servers + trust root from the live net just before the
+        joiner starts (reference test/e2e/runner/setup.go does the same with
+        a light-client trust height)."""
+        donors = [o for o in self.m.nodes
+                  if o.name in self.procs and not o.state_sync][:2]
+        if len(donors) < 2:
+            donors = donors * 2
+        h = self.rpc(donors[0].name, "status")["sync_info"]["latest_block_height"]
+        trust_h = max(1, int(h) - 2)
+        commit = self.rpc(donors[0].name, f"commit?height={trust_h}")
+        trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
+        cfg = self.configs[nm.name]
+        cfg.statesync.rpc_servers = [
+            f"http://127.0.0.1:{self._rpc_port(d.name)}" for d in donors]
+        cfg.statesync.trust_height = trust_h
+        cfg.statesync.trust_hash = trust_hash
+        cfg.save()
+
+    def load(self, n_txs: Optional[int] = None) -> None:
+        """Inject txs via broadcast_tx_sync round-robin over live nodes."""
+        names = [n.name for n in self.m.nodes if n.name in self.procs]
+        n_txs = n_txs if n_txs is not None else max(4, self.m.load_tx_rate * 2)
+        for i in range(n_txs):
+            tx = f"e2e{len(self.loaded_txs)}=v{i}".encode()
+            name = names[i % len(names)]
+            try:
+                self.rpc_post(name, "broadcast_tx_sync",
+                              {"tx": base64.b64encode(tx).decode()})
+                self.loaded_txs.append(tx)
+            except Exception:
+                pass  # a node may be mid-perturbation; coverage, not load
+            time.sleep(1.0 / max(1, self.m.load_tx_rate))
+
+    def perturb(self) -> None:
+        """Apply each node's perturbations sequentially
+        (test/e2e/runner/perturb.go)."""
+        for nm in self.m.nodes:
+            for p in nm.perturb:
+                proc = self.procs.get(nm.name)
+                if proc is None:
+                    continue
+                if p == "kill":
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait()
+                    time.sleep(2.0)
+                    self._launch(nm)
+                elif p == "restart":
+                    proc.send_signal(signal.SIGTERM)
+                    try:
+                        proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    self._launch(nm)
+                elif p == "pause":
+                    proc.send_signal(signal.SIGSTOP)
+                    time.sleep(5.0)
+                    proc.send_signal(signal.SIGCONT)
+                elif p == "disconnect":
+                    # no netns for subprocesses: a long stop makes every peer
+                    # drop the conn (ping timeout) and re-dial on CONT
+                    proc.send_signal(signal.SIGSTOP)
+                    time.sleep(12.0)
+                    proc.send_signal(signal.SIGCONT)
+                time.sleep(2.0)
+
+    def wait(self, blocks: Optional[int] = None) -> None:
+        """Let the net advance `blocks` past the current max height."""
+        target = self.max_height() + (blocks or self.m.wait_blocks)
+        self.wait_for_height(target)
+
+    def stop(self) -> None:
+        for proc in list(self.procs.values()) + list(self.signers.values()):
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        deadline = time.time() + 15
+        for proc in list(self.procs.values()) + list(self.signers.values()):
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                proc.kill()
+        if self._log:
+            self._log.close()
+
+    # -- RPC helpers ---------------------------------------------------------
+
+    def rpc(self, name: str, path: str, timeout: float = 5.0):
+        url = f"http://127.0.0.1:{self._rpc_port(name)}/{path}"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            doc = json.load(r)
+        if "error" in doc and doc["error"]:
+            raise E2EError(f"{name} /{path}: {doc['error']}")
+        return doc["result"]
+
+    def rpc_post(self, name: str, method: str, params: dict,
+                 timeout: float = 10.0):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": params}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self._rpc_port(name)}/", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            doc = json.load(r)
+        if "error" in doc and doc["error"]:
+            raise E2EError(f"{name} {method}: {doc['error']}")
+        return doc["result"]
+
+    def height(self, name: str) -> int:
+        try:
+            return int(self.rpc(name, "status")
+                       ["sync_info"]["latest_block_height"])
+        except Exception:
+            return -1
+
+    def max_height(self) -> int:
+        return max([self.height(n) for n in self.procs] or [0])
+
+    def wait_all_alive(self, timeout: float = 180.0) -> None:
+        """Block until every launched node answers /status — node startup
+        (python + jax import + WAL replay) can take a minute under CI load,
+        and invariants checked against a still-booting node read as a dead
+        net."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            down = [n for n in self.procs if self.height(n) < 0]
+            if not down:
+                return
+            for n in down:  # a crashed process will never answer
+                if self.procs[n].poll() is not None:
+                    raise E2EError(
+                        f"node {n} exited rc={self.procs[n].returncode}")
+            time.sleep(1.0)
+        raise E2EError(f"nodes never became reachable: {down}")
+
+    def wait_for_height(self, h: int, nodes: Optional[List[str]] = None,
+                        timeout: float = 180.0) -> None:
+        names = nodes or list(self.procs)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if any(self.height(n) >= h for n in names):
+                return
+            time.sleep(1.0)
+        raise E2EError(
+            f"height {h} not reached in {timeout}s: "
+            f"{ {n: self.height(n) for n in names} }")
+
+    # -- invariants (reference test/e2e/tests/) ------------------------------
+
+    def check_invariants(self) -> None:
+        self.check_heights_agree()
+        self.check_app_hashes()
+        self.check_txs_everywhere()
+
+    def check_heights_agree(self, spread: int = 3) -> None:
+        hs = {n: self.height(n) for n in self.procs}
+        if min(hs.values()) < 1:
+            raise E2EError(f"dead node: {hs}")
+        if max(hs.values()) - min(hs.values()) > spread:
+            # stragglers get a grace period to catch up
+            target = max(hs.values())
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                hs = {n: self.height(n) for n in self.procs}
+                if min(hs.values()) >= target - spread:
+                    return
+                time.sleep(1.0)
+            raise E2EError(f"heights diverged: {hs}")
+
+    def check_app_hashes(self) -> None:
+        """All nodes report the same app hash at a sampled common height."""
+        h = min(self.height(n) for n in self.procs) - 1
+        if h < 2:
+            raise E2EError("chain too short for app-hash check")
+        hashes = {}
+        for n in self.procs:
+            doc = self.rpc(n, f"commit?height={h}")
+            hashes[n] = doc["signed_header"]["header"]["app_hash"]
+        if len(set(hashes.values())) != 1:
+            raise E2EError(f"app hash mismatch at {h}: {hashes}")
+
+    def check_txs_everywhere(self) -> None:
+        """Every loaded tx's key is queryable on every node."""
+        if not self.loaded_txs:
+            return
+        sample = self.loaded_txs[:: max(1, len(self.loaded_txs) // 4)]
+        for n in self.procs:
+            for tx in sample:
+                key = tx.split(b"=", 1)[0]
+                q = self.rpc(
+                    n, f'abci_query?path=%22%22&data={key.hex()}', timeout=10)
+                value = q["response"].get("value")
+                if not value:
+                    raise E2EError(f"tx key {key!r} missing on {n}")
+
+    def check_evidence_committed(self, timeout: float = 90.0) -> None:
+        """A byzantine manifest must produce committed DuplicateVoteEvidence
+        (reference evidence pool -> block evidence path)."""
+        deadline = time.time() + timeout
+        names = list(self.procs)
+        while time.time() < deadline:
+            top = self.max_height()
+            for h in range(2, top):
+                for n in names:
+                    try:
+                        blk = self.rpc(n, f"block?height={h}")
+                    except Exception:
+                        continue
+                    ev = blk["block"].get("evidence") or []
+                    if ev:
+                        return
+            time.sleep(2.0)
+        raise E2EError("no evidence committed within deadline")
+
+    # -- one-call orchestration ----------------------------------------------
+
+    def run(self) -> None:
+        """setup → start → load → late joiners → perturb → load → wait →
+        invariants → stop. Raises E2EError on any failed invariant."""
+        self.setup()
+        try:
+            self.start()
+            self.load()
+            self.start_late_joiners()
+            self.wait_all_alive()
+            self.perturb()
+            self.load()
+            self.wait_all_alive()
+            self.wait()
+            self.check_invariants()
+            if any(nm.misbehaviors for nm in self.m.nodes):
+                self.check_evidence_committed()
+        finally:
+            self.stop()

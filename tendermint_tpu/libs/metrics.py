@@ -214,6 +214,32 @@ class ConsensusMetrics:
         self.quorum_prevote_delay = h(
             "consensus", "quorum_prevote_delay",
             "Seconds from proposal timestamp to 2/3 prevotes.")
+        self.missing_validators_power = g(
+            "consensus", "missing_validators_power",
+            "Voting power of validators missing from the last commit.")
+        self.byzantine_validators_power = g(
+            "consensus", "byzantine_validators_power",
+            "Voting power of validators that equivocated.")
+        self.validator_power = g(
+            "consensus", "validator_power",
+            "This node's voting power (0 when not a validator).")
+        self.validator_last_signed_height = g(
+            "consensus", "validator_last_signed_height",
+            "Last height this node's validator signed.")
+        self.validator_missed_blocks = c(
+            "consensus", "validator_missed_blocks",
+            "Blocks this node's validator missed signing.")
+        self.committed_height = g(
+            "consensus", "committed_height", "Latest committed height.")
+        self.state_syncing = g(
+            "consensus", "state_syncing",
+            "Whether the node is state syncing.")
+        self.proposal_receive_count = c(
+            "consensus", "proposal_receive_count",
+            "Proposals received.", ["status"])
+        self.latest_block_height = g(
+            "consensus", "latest_block_height",
+            "Alias of committed height for dashboards.")
 
 
 class MempoolMetrics:

@@ -91,7 +91,11 @@ class HTTPProvider(Provider):
                 raise ProviderError("provider returned an empty validator page")
             vals.extend(got)
             page += 1
-        return LightBlock(sh, ValidatorSet(vals))
+        # priorities in the RPC answer are live: rebuild WITHOUT the
+        # NewValidatorSet increment (validator_set.go
+        # ValidatorSetFromExistingValidators) or proposer selection on a
+        # statesync-bootstrapped node diverges from the network
+        return LightBlock(sh, ValidatorSet.from_existing(vals))
 
 
 # -- JSON -> domain decoding (inverse of rpc/json_enc.py) --------------------

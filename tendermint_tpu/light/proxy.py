@@ -3,10 +3,12 @@ VERIFIES everything verifiable against light-client state before answering
 (reference light/proxy/proxy.go, light/rpc/client.go — the `light` CLI).
 
 Verified routes: ``commit``, ``block``, ``validators`` (checked against a
-light-client-verified header: header hash, data hash, validator hashes).
-Forwarded as-is (unverifiable without app proofs): ``status``, ``health``,
-``genesis``, ``abci_query`` (proof-op verification plugs in through
-crypto/merkle.ProofRuntime once the app serves proofs), broadcast routes.
+light-client-verified header: header hash, data hash, validator hashes) and
+``abci_query`` (the primary is forced to prove: its merkle ``ProofOps`` are
+run through crypto/merkle.ProofRuntime against the light-client-verified
+app hash at query-height+1 — reference light/rpc/client.go
+ABCIQueryWithOptions). Forwarded as-is: ``status``, ``health``,
+``genesis``, broadcast routes.
 """
 
 from __future__ import annotations
@@ -25,11 +27,11 @@ from .provider import _decode_signed_header, _decode_validators
 logger = logging.getLogger("tmtpu.light.proxy")
 
 FORWARD_ROUTES = [
-    "health", "status", "genesis", "net_info", "abci_info", "abci_query",
+    "health", "status", "genesis", "net_info", "abci_info",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
 ]
-VERIFIED_ROUTES = ["commit", "block", "validators"]
+VERIFIED_ROUTES = ["commit", "block", "validators", "abci_query"]
 
 
 class LightProxy:
@@ -94,6 +96,43 @@ class LightProxy:
                                    "hash to the verified header")
         return doc
 
+    async def _verified_abci_query(self, params: Dict[str, Any]
+                                   ) -> Dict[str, Any]:
+        """(light/rpc/client.go ABCIQueryWithOptions) force prove=true on
+        the primary; run the returned ProofOps against the light-verified
+        app hash. AppHash(H+1) commits the query state at H."""
+        from ..crypto.merkle import ProofOp, default_proof_runtime, key_path
+
+        path = params.get("path") or ""
+        data = bytes.fromhex(params.get("data") or "")
+        doc = await self.rpc.abci_query(path, data,
+                                        height=int(params.get("height") or 0),
+                                        prove=True)
+        resp = doc["response"]
+        if int(resp.get("code") or 0) != 0:
+            return doc  # app-level error: nothing to verify
+        value = base64.b64decode(resp.get("value") or "")
+        h = int(resp.get("height") or 0)
+        if h <= 0:
+            raise RPCError(-32603, "primary returned no query height")
+        ops_doc = (resp.get("proofOps") or {}).get("ops") or []
+        if not ops_doc:
+            raise RPCError(-32603, "primary returned no proof for the query "
+                                   "(absence proofs are not supported)")
+        ops = [ProofOp(type=o["type"], key=base64.b64decode(o.get("key") or ""),
+                       data=base64.b64decode(o.get("data") or ""))
+               for o in ops_doc]
+        lb = await self.lc.verify_light_block_at_height(h + 1)
+        app_hash = lb.signed_header.header.app_hash
+        try:
+            default_proof_runtime().verify_value(
+                ops, app_hash, key_path(resp_key := base64.b64decode(
+                    resp.get("key") or "") or data), value)
+        except ValueError as e:
+            raise RPCError(-32603, f"query proof verification failed "
+                                   f"for key {resp_key!r}: {e}")
+        return doc
+
     # -- server --------------------------------------------------------------
 
     async def _dispatch(self, method: str, params: Dict[str, Any]):
@@ -104,6 +143,8 @@ class LightProxy:
             return await self._verified_block(height)
         if method == "validators":
             return await self._verified_validators(height)
+        if method == "abci_query":
+            return await self._verified_abci_query(params)
         if method in FORWARD_ROUTES:
             return await self.rpc.call(method, **params)
         raise RPCError(-32601, f"method {method!r} not supported by the "

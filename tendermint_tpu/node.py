@@ -41,8 +41,22 @@ from .types.priv_validator import PrivValidator
 logger = logging.getLogger("tmtpu.node")
 
 # built-in ABCI apps resolvable by name from config.base.proxy_app
+def _snapshot_kvstore():
+    from .abci.example.kvstore import SnapshotKVStoreApplication
+
+    return SnapshotKVStoreApplication()
+
+
+def _merkle_kvstore():
+    from .abci.example.kvstore import MerkleKVStoreApplication
+
+    return MerkleKVStoreApplication()
+
+
 BUILTIN_APPS = {
     "kvstore": KVStoreApplication,
+    "kvstore-snapshot": _snapshot_kvstore,
+    "kvstore-merkle": _merkle_kvstore,
 }
 
 
@@ -74,6 +88,10 @@ class Node:
             creator = local_client_creator(app)
         elif config.base.abci == "socket":
             creator = socket_client_creator(config.base.proxy_app)
+        elif config.base.abci == "grpc":
+            from .proxy import grpc_client_creator
+
+            creator = grpc_client_creator(config.base.proxy_app)
         else:
             app_cls = BUILTIN_APPS.get(config.base.proxy_app)
             if app_cls is None:
@@ -121,9 +139,27 @@ class Node:
         wal_path = config.wal_file()
         os.makedirs(os.path.dirname(wal_path), exist_ok=True)
         wal = WAL(wal_path)
+        # byzantine e2e hook (reference test/maverick node selected via the
+        # e2e manifest): TMTPU_MISBEHAVIORS="3:double-prevote,5:double-prevote"
+        # arms the height-keyed misbehavior seam; TMTPU_UNSAFE_PV=1 swaps the
+        # double-sign-protected FilePV for a raw MockPV over the same key so
+        # the misbehavior can actually equivocate. Test-only, env-gated.
+        misbehaviors = {}
+        if os.environ.get("TMTPU_MISBEHAVIORS"):
+            for part in os.environ["TMTPU_MISBEHAVIORS"].split(","):
+                h, _, name = part.partition(":")
+                misbehaviors[int(h)] = name
+            if (os.environ.get("TMTPU_UNSAFE_PV") == "1"
+                    and priv_validator is not None
+                    and hasattr(priv_validator, "priv_key")):
+                from .types.priv_validator import MockPV
+
+                priv_validator = MockPV(priv_validator.priv_key)
+
         self.consensus_state = ConsensusState(
             config.consensus, state, self.block_exec, self.block_store,
             evpool=self.evidence_pool, wal=wal)
+        self.consensus_state.misbehaviors = misbehaviors
         self.consensus_state.set_event_bus(self.event_bus)
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
@@ -163,6 +199,9 @@ class Node:
         self.consensus_state.metrics = self.metrics.consensus
         self.mempool.metrics = self.metrics.mempool
         self.block_exec.metrics = self.metrics.state
+        from .p2p.conn.mconnection import set_p2p_metrics
+
+        set_p2p_metrics(self.metrics.p2p)
 
         # -- tx/block indexer (node.go:745 createAndStartIndexerService) ----
         self.indexer_service = None
@@ -214,9 +253,13 @@ class Node:
                 config._rootify(config.p2p.addr_book_file),
                 strict=config.p2p.addr_book_strict)
             self.addr_book.add_our_address(node_key.id)
+            # seed the book from config.p2p.seeds (node.go:600 createAddrBook)
+            for addr in parse_peer_list(config.p2p.seeds):
+                self.addr_book.add_address(addr)
             self.pex_reactor = PEXReactor(
                 self.addr_book,
-                target_outbound=config.p2p.max_num_outbound_peers)
+                target_outbound=config.p2p.max_num_outbound_peers,
+                seed_mode=config.p2p.seed_mode)
             reactors["PEX"] = self.pex_reactor
             descs.extend(self.pex_reactor.get_channels())
         else:

@@ -89,6 +89,16 @@ class _Channel:
         return bool(self.sending) or not self.queue.empty()
 
 
+# process-wide p2p byte counters (p2p/metrics.go): set once by the node;
+# None (tests, tools) is a no-op
+_p2p_metrics = None
+
+
+def set_p2p_metrics(m) -> None:
+    global _p2p_metrics
+    _p2p_metrics = m
+
+
 class MConnection:
     def __init__(self, conn, chan_descs: List[ChannelDescriptor],
                  on_receive: Callable[[int, bytes], Awaitable[None]],
@@ -203,6 +213,9 @@ class MConnection:
                     continue
                 await self._throttle(len(pkt))
                 await self.conn.write(pkt)
+                if _p2p_metrics is not None:
+                    _p2p_metrics.peer_send_bytes_total.labels(
+                        f"{ch.desc.id:#x}").inc(len(pkt))
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -236,6 +249,9 @@ class MConnection:
                 elif 3 in fields:  # PacketMsg
                     pkt = pw.fields_dict(fields[3][0])
                     ch_id = pkt.get(1, [0])[0]
+                    if _p2p_metrics is not None:
+                        _p2p_metrics.peer_receive_bytes_total.labels(
+                            f"{ch_id:#x}").inc(len(msg))
                     eof = bool(pkt.get(2, [0])[0])
                     data = pkt.get(3, [b""])[0]
                     ch = self.channels.get(ch_id)

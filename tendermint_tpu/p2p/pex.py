@@ -206,7 +206,11 @@ class AddrBook:
 def _routable(addr: NetAddress) -> bool:
     # strict mode refuses obviously-unroutable junk; localhost allowed for
     # localnets (the reference gates this by addrBookStrict=false in tests)
-    return bool(addr.host) and 0 < addr.port < 65536
+    if not addr.host or not 0 < addr.port < 65536:
+        return False
+    if addr.host in ("0.0.0.0", "::", "255.255.255.255"):
+        return False
+    return True
 
 
 # -- reactor ------------------------------------------------------------------
@@ -217,7 +221,10 @@ class PEXReactor(Reactor):
 
     def __init__(self, book: AddrBook, target_outbound: int = 10,
                  ensure_interval: float = 5.0,
-                 request_interval: float = REQUEST_INTERVAL):
+                 request_interval: float = REQUEST_INTERVAL,
+                 seed_mode: bool = False,
+                 seed_disconnect_wait: float = 3.0,
+                 crawl_interval: float = 30.0):
         super().__init__("PEX")
         self.book = book
         self.target_outbound = target_outbound
@@ -225,10 +232,19 @@ class PEXReactor(Reactor):
         # both the flood defense AND our own outgoing request pacing
         # (pex_reactor.go ensurePeers + receiveRequest share the interval)
         self.request_interval = request_interval
+        # seed mode (pex_reactor.go seed branch): crawl the book to keep it
+        # fresh; serve inbound peers one selection then hang up
+        self.seed_mode = seed_mode
+        self.seed_disconnect_wait = seed_disconnect_wait
+        self.crawl_interval = crawl_interval
         self._last_request: Dict[str, float] = {}   # inbound, per peer
         self._last_sent: Dict[str, float] = {}      # outgoing, per peer
         self._requested: set = set()
         self._task: Optional[asyncio.Task] = None
+        self._crawl_task: Optional[asyncio.Task] = None
+        # strong refs: the loop holds only weak refs to tasks, and a
+        # GC-collected disconnect task would leave a served peer connected
+        self._bg_tasks: set = set()
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
@@ -236,13 +252,18 @@ class PEXReactor(Reactor):
                                   recv_message_capacity=64 * 1024)]
 
     async def start(self) -> None:
-        if self._task is None:
+        if self.seed_mode:
+            if self._crawl_task is None:
+                self._crawl_task = asyncio.create_task(self._crawl_routine())
+        elif self._task is None:
             self._task = asyncio.create_task(self._ensure_peers_routine())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+        for attr in ("_task", "_crawl_task"):
+            t = getattr(self, attr)
+            if t is not None:
+                t.cancel()
+                setattr(self, attr, None)
         self.book.save()
 
     async def add_peer(self, peer: Peer) -> None:
@@ -275,6 +296,13 @@ class PEXReactor(Reactor):
             self._last_request[peer.id] = now
             peer.try_send(PEX_CHANNEL,
                           encode_pex_addrs(self.book.get_selection()))
+            if self.seed_mode:
+                # seeds answer one request then hang up (pex_reactor.go
+                # receiveRequest seed branch): they hand out addresses,
+                # they don't hold connections
+                t = asyncio.create_task(self._disconnect_later(peer))
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
         else:  # addrs
             if peer.id not in self._requested:
                 # unsolicited address dump (pex_reactor.go ReceiveAddrs err)
@@ -289,6 +317,51 @@ class PEXReactor(Reactor):
     async def remove_peer(self, peer: Peer, reason: str) -> None:
         self._last_request.pop(peer.id, None)
         self._requested.discard(peer.id)
+
+    async def _disconnect_later(self, peer: Peer) -> None:
+        try:
+            await asyncio.sleep(self.seed_disconnect_wait)
+            if self.switch is not None:
+                await self.switch.stop_peer_gracefully(peer)
+        except Exception:
+            pass
+
+    # -- seed crawler (pex_reactor.go crawlPeersRoutine) --------------------
+
+    async def _crawl_routine(self) -> None:
+        try:
+            while True:
+                await self._crawl_once()
+                await asyncio.sleep(self.crawl_interval)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("pex crawler died")
+
+    async def _crawl_once(self) -> None:
+        """Dial a few book addresses, request their addresses, hang up
+        shortly after (crawlPeers): keeps the book fresh and prunes dead
+        entries via mark_attempt accounting."""
+        if self.switch is None:
+            return
+        exclude = set(self.switch.peers) | {self.switch.node_id}
+        for _ in range(3):
+            addr = self.book.pick_address(exclude)
+            if addr is None:
+                return
+            exclude.add(addr.id)
+            self.book.mark_attempt(addr)
+            ok = await self.switch.dial_peer(addr)
+            if not ok:
+                continue
+            self.book.mark_good(addr.id)
+            peer = self.switch.peers.get(addr.id)
+            if peer is None:
+                continue
+            self._requested.add(peer.id)
+            peer.try_send(PEX_CHANNEL, encode_pex_request())
+            await asyncio.sleep(self.seed_disconnect_wait)
+            await self.switch.stop_peer_gracefully(peer)
 
     # -- the ensure-peers loop (pex_reactor.go ensurePeersRoutine) ----------
 

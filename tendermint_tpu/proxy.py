@@ -23,6 +23,15 @@ def socket_client_creator(addr: str) -> ClientCreator:
     return lambda: SocketClient(addr)
 
 
+def grpc_client_creator(addr: str) -> ClientCreator:
+    """(proxy/client.go NewRemoteClientCreator transport=grpc)"""
+    def make():
+        from .abci.grpc import GrpcClient
+
+        return GrpcClient(addr)
+    return make
+
+
 class AppConns:
     """(proxy/multi_app_conn.go)"""
 

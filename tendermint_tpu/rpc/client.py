@@ -94,8 +94,10 @@ class HTTPClient:
         return await self.call("broadcast_tx_commit",
                                tx=base64.b64encode(tx).decode())
 
-    async def abci_query(self, path: str, data: bytes) -> Dict[str, Any]:
-        return await self.call("abci_query", path=path, data=data.hex())
+    async def abci_query(self, path: str, data: bytes, height: int = 0,
+                         prove: bool = False) -> Dict[str, Any]:
+        return await self.call("abci_query", path=path, data=data.hex(),
+                               height=height, prove=prove)
 
 
 class LocalClient:

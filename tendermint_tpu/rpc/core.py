@@ -232,6 +232,118 @@ class Environment:
                 rs.proposal_block.hash() if rs.proposal_block else b""),
         }}
 
+    async def dump_consensus_state(self) -> Dict[str, Any]:
+        """(rpc/core/consensus.go DumpConsensusState) full round state with
+        vote bit-arrays + per-peer round states — the wedged-net diagnostic."""
+        cs = self.node.consensus_state
+        rs = cs.rs
+        votes = []
+        if rs.votes is not None:
+            for r in range(rs.round + 1):
+                pv = rs.votes.prevotes(r)
+                pc = rs.votes.precommits(r)
+                votes.append({
+                    "round": r,
+                    "prevotes": str(pv.bit_array()) if pv else "nil",
+                    "prevotes_bit_array": str(pv.bit_array()) if pv else "",
+                    "precommits": str(pc.bit_array()) if pc else "nil",
+                    "precommits_bit_array": str(pc.bit_array()) if pc else "",
+                })
+        round_state = {
+            "height": str(rs.height), "round": rs.round, "step": int(rs.step),
+            "start_time": rfc3339(rs.start_time_ns),
+            "commit_time": rfc3339(rs.commit_time_ns),
+            "proposal": ({"height": str(rs.proposal.height),
+                          "round": rs.proposal.round,
+                          "pol_round": rs.proposal.pol_round}
+                         if rs.proposal else None),
+            "proposal_block_hash": hexu(
+                rs.proposal_block.hash() if rs.proposal_block else b""),
+            "locked_round": rs.locked_round,
+            "locked_block_hash": hexu(
+                rs.locked_block.hash() if rs.locked_block else b""),
+            "valid_round": rs.valid_round,
+            "valid_block_hash": hexu(
+                rs.valid_block.hash() if rs.valid_block else b""),
+            "height_vote_set": votes,
+            "triggered_timeout_precommit": rs.triggered_timeout_precommit,
+        }
+        peers = []
+        reactor = getattr(self.node, "consensus_reactor", None)
+        for pid, ps in (getattr(reactor, "_peer_states", {}) or {}).items():
+            prs = getattr(ps, "prs", None)
+            peers.append({
+                "node_address": pid,
+                "peer_state": {
+                    "height": str(getattr(prs, "height", 0)),
+                    "round": getattr(prs, "round", -1),
+                    "step": int(getattr(prs, "step", 0) or 0),
+                } if prs is not None else None,
+            })
+        return {"round_state": round_state, "peers": peers}
+
+    async def check_tx(self, tx: str = "") -> Dict[str, Any]:
+        """(rpc/core/mempool.go CheckTx route) run CheckTx against the app
+        WITHOUT adding to the mempool."""
+        from ..abci import types as abci
+
+        raw = _decode_tx_param(tx)
+        resp = self.node.proxy_app.mempool.check_tx(
+            abci.RequestCheckTx(tx=raw))
+        return {
+            "code": resp.code, "data": b64(getattr(resp, "data", b"")),
+            "log": resp.log, "info": getattr(resp, "info", ""),
+            "gas_wanted": str(resp.gas_wanted),
+            "gas_used": str(getattr(resp, "gas_used", 0)),
+            "codespace": getattr(resp, "codespace", ""),
+        }
+
+    async def genesis_chunked(self, chunk: int = 0) -> Dict[str, Any]:
+        """(rpc/core/net.go GenesisChunked) base64 chunks of the genesis doc
+        for genesis files too large for one response."""
+        import base64 as _b64
+
+        doc = self.node.genesis.to_json().encode()
+        size = 16 * 1024 * 1024
+        chunks = [doc[i:i + size] for i in range(0, max(len(doc), 1), size)]
+        c = int(chunk)
+        if not 0 <= c < len(chunks):
+            raise RPCError(-32602, f"chunk {c} out of range 0..{len(chunks)-1}")
+        return {"chunk": str(c), "total": str(len(chunks)),
+                "data": _b64.b64encode(chunks[c]).decode()}
+
+    # -- unsafe routes (routes.go:52; served only with rpc.unsafe) -----------
+
+    @staticmethod
+    def _addr_list(value) -> str:
+        """Accept a JSON list or a single comma-separated string (the URI
+        GET interface always delivers one string)."""
+        if value is None:
+            return ""
+        if isinstance(value, str):
+            return value
+        return ",".join(value)
+
+    async def dial_seeds(self, seeds=None) -> Dict[str, Any]:
+        from ..p2p import parse_peer_list
+
+        self.node.switch.dial_peers_async(
+            parse_peer_list(self._addr_list(seeds)))
+        return {"log": f"dialing seeds: {seeds}"}
+
+    async def dial_peers(self, peers=None,
+                         persistent: bool = False) -> Dict[str, Any]:
+        from ..p2p import parse_peer_list
+
+        self.node.switch.dial_peers_async(
+            parse_peer_list(self._addr_list(peers)),
+            persistent=bool(persistent))
+        return {"log": f"dialing peers: {peers}"}
+
+    async def unsafe_flush_mempool(self) -> Dict[str, Any]:
+        self.node.mempool.flush()
+        return {}
+
     async def consensus_params(self, height: Optional[int] = None) -> Dict[str, Any]:
         h = self._height_or_latest(height)
         params = self.node.state_store.load_consensus_params(h)
@@ -263,12 +375,17 @@ class Environment:
         resp = self.node.proxy_app.query.query(abci.RequestQuery(
             data=bytes.fromhex(data) if data else b"",
             path=path, height=int(height), prove=bool(prove)))
-        return {"response": {
+        out = {
             "code": resp.code, "log": resp.log, "info": resp.info,
             "index": str(resp.index), "key": b64(resp.key),
             "value": b64(resp.value), "height": str(resp.height),
             "codespace": resp.codespace,
-        }}
+        }
+        if resp.proof_ops:
+            out["proofOps"] = {"ops": [
+                {"type": op.type, "key": b64(op.key), "data": b64(op.data)}
+                for op in resp.proof_ops]}
+        return {"response": out}
 
     # -- mempool / broadcast (rpc/core/mempool.go) ---------------------------
 
@@ -407,13 +524,17 @@ def _decode_tx_param(tx: str) -> bytes:
 
 # the route table (routes.go:10-49); name -> handler attribute
 ROUTES = [
-    "health", "status", "net_info", "genesis", "blockchain", "block",
-    "block_by_hash", "block_results", "commit", "validators",
-    "consensus_state", "consensus_params", "abci_info", "abci_query",
+    "health", "status", "net_info", "genesis", "genesis_chunked",
+    "blockchain", "block", "block_by_hash", "block_results", "commit",
+    "check_tx", "validators", "consensus_state", "dump_consensus_state",
+    "consensus_params", "abci_info", "abci_query",
     "unconfirmed_txs", "num_unconfirmed_txs", "broadcast_tx_async",
     "broadcast_tx_sync", "broadcast_tx_commit", "broadcast_evidence",
     "tx", "tx_search", "block_search",
 ]
+
+# served only when config.rpc.unsafe is set (routes.go:52 AddUnsafeRoutes)
+UNSAFE_ROUTES = ["dial_seeds", "dial_peers", "unsafe_flush_mempool"]
 
 
 def _enc_tx_search_result(r) -> Dict[str, Any]:

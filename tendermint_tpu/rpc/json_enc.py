@@ -29,7 +29,10 @@ def rfc3339(ns: int) -> str:
     are ns-exact and MUST round-trip, or recomputed header hashes diverge."""
     secs, frac = divmod(ns, 1_000_000_000)
     dt = datetime.datetime.fromtimestamp(secs, tz=datetime.timezone.utc)
-    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{frac:09d}Z"
+    # strftime leaves year 1 (Go zero time) unpadded — pad explicitly so
+    # the string stays ISO-parseable on the way back in
+    return (f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+            f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}.{frac:09d}Z")
 
 
 def enc_block_id(bid: Optional[BlockID]) -> Dict[str, Any]:
@@ -80,11 +83,43 @@ def enc_commit(c: Optional[Commit]) -> Optional[Dict[str, Any]]:
     }
 
 
+def enc_vote(v) -> Dict[str, Any]:
+    return {
+        "type": int(v.type),
+        "height": str(v.height),
+        "round": int(v.round),
+        "block_id": enc_block_id(v.block_id),
+        "timestamp": rfc3339(v.timestamp_ns),
+        "validator_address": hexu(v.validator_address),
+        "validator_index": int(v.validator_index),
+        "signature": b64(v.signature),
+    }
+
+
+def enc_evidence(ev) -> Dict[str, Any]:
+    """(types/evidence.go json shapes; DuplicateVoteEvidence is the one the
+    e2e byzantine invariant scans for)"""
+    kind = type(ev).__name__
+    if kind == "DuplicateVoteEvidence":
+        return {
+            "type": "tendermint/DuplicateVoteEvidence",
+            "value": {
+                "vote_a": enc_vote(ev.vote_a),
+                "vote_b": enc_vote(ev.vote_b),
+                "total_voting_power": str(getattr(ev, "total_voting_power", 0)),
+                "validator_power": str(getattr(ev, "validator_power", 0)),
+                "timestamp": rfc3339(ev.timestamp_ns),
+            },
+        }
+    return {"type": f"tendermint/{kind}",
+            "value": {"height": str(getattr(ev, "height", 0))}}
+
+
 def enc_block(b: Block) -> Dict[str, Any]:
     return {
         "header": enc_header(b.header),
         "data": {"txs": [b64(tx) for tx in b.data.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {"evidence": [enc_evidence(e) for e in b.evidence]},
         "last_commit": enc_commit(b.last_commit),
     }
 

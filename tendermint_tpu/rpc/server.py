@@ -14,7 +14,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from .core import Environment, ROUTES, RPCError
+from .core import Environment, ROUTES, UNSAFE_ROUTES, RPCError
 
 logger = logging.getLogger("tmtpu.rpc")
 
@@ -33,12 +33,15 @@ class RPCServer:
         self.env = Environment(node)
         self._runner: Optional[web.AppRunner] = None
         self._subscriptions: Dict[str, list] = {}  # ws id -> [sub ids]
+        self._routes = list(ROUTES)
+        if getattr(node.config.rpc, "unsafe", False):
+            self._routes += UNSAFE_ROUTES
 
     async def start(self, laddr: str) -> None:
         app = web.Application(client_max_size=self.node.config.rpc.max_body_bytes)
         app.router.add_post("/", self._handle_jsonrpc)
         app.router.add_get("/websocket", self._handle_websocket)
-        for name in ROUTES:
+        for name in self._routes:
             app.router.add_get(f"/{name}", self._make_uri_handler(name))
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -73,7 +76,7 @@ class RPCServer:
         id_ = req.get("id")
         method = req.get("method", "")
         params = req.get("params") or {}
-        if method not in ROUTES:
+        if method not in self._routes:
             return _rpc_response(id_, error=RPCError(-32601,
                                                      f"method {method!r} not found"))
         handler = getattr(self.env, method)

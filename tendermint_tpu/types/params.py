@@ -16,6 +16,7 @@ MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB (types/params.go MaxBlockSizeBytes)
 
 ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
 ABCI_PUBKEY_TYPE_SECP256K1 = "secp256k1"
+ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
 
 
 @dataclass
@@ -144,7 +145,8 @@ class ConsensusParams:
         if len(self.validator.pub_key_types) == 0:
             raise ValueError("len(Validator.PubKeyTypes) must be greater than 0")
         for t in self.validator.pub_key_types:
-            if t not in (ABCI_PUBKEY_TYPE_ED25519, ABCI_PUBKEY_TYPE_SECP256K1):
+            if t not in (ABCI_PUBKEY_TYPE_ED25519, ABCI_PUBKEY_TYPE_SECP256K1,
+                         ABCI_PUBKEY_TYPE_SR25519):
                 raise ValueError(f"unknown pubkey type {t}")
 
     def update(self, updates) -> "ConsensusParams":

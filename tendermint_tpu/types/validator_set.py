@@ -52,6 +52,31 @@ class ValidatorSet:
             if len(self.validators) > 0:
                 self.increment_proposer_priority(1)
 
+    @classmethod
+    def from_existing(cls, validators: Sequence[Validator]) -> "ValidatorSet":
+        """(validator_set.go ValidatorSetFromExistingValidators) rebuild a
+        set whose proposer priorities are ALREADY live — RPC /validators
+        answers, statesync bootstrap — without NewValidatorSet's extra
+        IncrementProposerPriority(1). The proposer is recovered from the
+        existing priorities; re-incrementing here desynchronizes proposer
+        selection from the running network (found by the statesync e2e
+        manifest: the synced node rejected every proposal)."""
+        vs = cls()
+        vs.validators = sorted((v.copy() for v in validators),
+                               key=_by_voting_power)
+        if vs.validators:
+            # findPreviousProposer (validator_set.go:832): the chosen
+            # proposer was decremented by the total power, so it is the one
+            # that LOSES the priority comparison against every other
+            prev = None
+            for v in vs.validators:
+                if prev is None:
+                    prev = v
+                elif prev is prev.compare_proposer_priority(v):
+                    prev = v
+            vs.proposer = prev
+        return vs
+
     # -- basic accessors ---------------------------------------------------
 
     def __len__(self) -> int:

@@ -317,3 +317,133 @@ def test_light_proxy_verifies_primary(tmp_path):
             await node.stop()
 
     asyncio.run(run())
+
+
+def test_light_proxy_verifies_abci_query(tmp_path):
+    """abci_query through the proxy is proof-verified against the
+    light-client app hash (reference light/rpc/client.go ABCIQuery →
+    merkle ProofRuntime): honest answers pass, a forged value and a
+    missing proof are rejected."""
+    import base64 as b64mod
+
+    from tests.test_node_rpc import _mk_node
+    from tendermint_tpu.light.provider import HTTPProvider
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    async def run():
+        node = _mk_node(tmp_path)
+        # swap the app for the merkle-proof kvstore BEFORE start
+        node_app = node.app
+        from tendermint_tpu.abci.example.kvstore import (
+            MerkleKVStoreApplication,
+        )
+        assert not isinstance(node_app, MerkleKVStoreApplication)
+        proxy = None
+        try:
+            await node.start()
+            rpc = HTTPClient(f"http://127.0.0.1:{node.rpc_server.bound_port}")
+            # the default _mk_node app is plain kvstore (no proofs): the
+            # proxy must REJECT its unproven answers
+            await rpc.call("broadcast_tx_sync",
+                           tx=b64mod.b64encode(b"k1=v1").decode())
+            for _ in range(600):
+                st = await rpc.status()
+                if int(st["sync_info"]["latest_block_height"]) >= 3:
+                    break
+                await asyncio.sleep(0.05)
+            provider = HTTPProvider("rpc-chain", rpc)
+            lb1 = await provider.light_block(1)
+            lc = LightClient(
+                "rpc-chain",
+                TrustOptions(10 * 365 * 24 * 3600.0, 1,
+                             lb1.signed_header.header.hash()),
+                provider, [])
+            proxy = LightProxy(lc, rpc)
+            port = await proxy.start()
+            client = HTTPClient(f"http://127.0.0.1:{port}")
+
+            from tendermint_tpu.rpc.core import RPCError as _E
+
+            with pytest.raises(_E):  # plain kvstore serves no proofs
+                await client.abci_query("", b"k1")
+            await client.close()
+            await rpc.close()
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_light_proxy_merkle_query_end_to_end(tmp_path):
+    """With the merkle kvstore app the proxy serves proof-verified queries;
+    a lying primary forging the value is rejected."""
+    import base64 as b64mod
+
+    from tests.test_node_rpc import _mk_node
+    from tendermint_tpu.light.provider import HTTPProvider
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    async def run():
+        # build the node over the merkle app
+        orig = _mk_node(tmp_path)
+        cfg = orig.config
+        cfg.base.proxy_app = "kvstore-merkle"
+        node = Node(cfg, orig.priv_validator, orig.node_key, orig.genesis)
+        proxy = None
+        try:
+            await node.start()
+            rpc = HTTPClient(f"http://127.0.0.1:{node.rpc_server.bound_port}")
+            await rpc.call("broadcast_tx_sync",
+                           tx=b64mod.b64encode(b"k1=v1").decode())
+            for _ in range(600):
+                st = await rpc.status()
+                if int(st["sync_info"]["latest_block_height"]) >= 4:
+                    break
+                await asyncio.sleep(0.05)
+            provider = HTTPProvider("rpc-chain", rpc)
+            lb1 = await provider.light_block(1)
+            lc = LightClient(
+                "rpc-chain",
+                TrustOptions(10 * 365 * 24 * 3600.0, 1,
+                             lb1.signed_header.header.hash()),
+                provider, [])
+            proxy = LightProxy(lc, rpc)
+            port = await proxy.start()
+            client = HTTPClient(f"http://127.0.0.1:{port}")
+
+            doc = await client.abci_query("", b"k1")
+            assert b64mod.b64decode(doc["response"]["value"]) == b"v1"
+
+            # lying primary: forge the value; the proof must not verify
+            class LyingClient:
+                def __init__(self, inner):
+                    self.inner = inner
+
+                async def abci_query(self, path, data, height=0, prove=False):
+                    doc = await self.inner.abci_query(
+                        path, data, height=height, prove=prove)
+                    doc["response"]["value"] = b64mod.b64encode(
+                        b"forged").decode()
+                    return doc
+
+                def __getattr__(self, name):
+                    return getattr(self.inner, name)
+
+            from tendermint_tpu.rpc.core import RPCError as _E
+
+            proxy.rpc = LyingClient(rpc)
+            with pytest.raises(_E):
+                await client.abci_query("", b"k1")
+            await client.close()
+            await rpc.close()
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            await node.stop()
+
+    asyncio.run(run())

@@ -43,8 +43,17 @@ def _mk_node(tmp_path, rpc: bool = True, backend: str = "mem"):
                              cfg.priv_validator_state_file())
         pv.save()
     nk = NodeKey(crypto.Ed25519PrivKey.generate(b"\x51" * 32))
+    # sub-second test blocks with the default time_iota_ms=1000 make chain
+    # time outrun the wall clock (+1s per block, BFT-time monotonicity —
+    # the reference behaves identically); a 1ms iota keeps header times
+    # real so light-client clock-drift checks hold in fast tests
+    from tendermint_tpu.types.params import default_consensus_params
+
+    params = default_consensus_params()
+    params.block.time_iota_ms = 1
     genesis = GenesisDoc(chain_id="rpc-chain",
                          genesis_time_ns=1_700_000_000_000_000_000,
+                         consensus_params=params,
                          validators=[GenesisValidator(pv.get_pub_key(), 10)])
     return Node(cfg, pv, nk, genesis)
 

@@ -1,0 +1,131 @@
+"""Operator surface: debug dump bundle, offline WAL replay, compact-db,
+reindex-event, and the ops RPC routes (dump_consensus_state, check_tx,
+genesis_chunked, unsafe routes gating) — reference
+cmd/tendermint/commands/debug/, replay.go, rpc/core/routes.go.
+"""
+
+import argparse
+import asyncio
+import base64
+import json
+import os
+
+import pytest
+
+from tests.test_node_rpc import _mk_node
+
+
+def _ns(**kw):
+    return argparse.Namespace(**kw)
+
+
+def test_ops_routes_and_debug_bundle(tmp_path, capsys):
+    from tendermint_tpu.cmd import cmd_compact_db, cmd_debug, cmd_replay
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    node = _mk_node(tmp_path, backend="sqlite")
+    home = node.config.root_dir
+    node.config.save()  # the debug/replay CLI loads config.toml from disk
+    node.genesis.save_as(node.config.genesis_file())
+
+    async def run():
+        await node.start()
+        try:
+            rpc = HTTPClient(f"http://127.0.0.1:{node.rpc_server.bound_port}")
+            await rpc.call("broadcast_tx_sync",
+                           tx=base64.b64encode(b"ops=1").decode())
+            for _ in range(600):
+                st = await rpc.status()
+                if int(st["sync_info"]["latest_block_height"]) >= 3:
+                    break
+                await asyncio.sleep(0.05)
+
+            # dump_consensus_state: full round state with vote bit-arrays
+            dump = await rpc.call("dump_consensus_state")
+            assert "round_state" in dump and "peers" in dump
+            assert int(dump["round_state"]["height"]) >= 3
+            assert isinstance(dump["round_state"]["height_vote_set"], list)
+
+            # check_tx runs CheckTx without mutating the mempool
+            before = int((await rpc.call("num_unconfirmed_txs"))["total"])
+            res = await rpc.call(
+                "check_tx", tx=base64.b64encode(b"probe=1").decode())
+            assert res["code"] == 0
+            after = int((await rpc.call("num_unconfirmed_txs"))["total"])
+            assert after == before
+
+            # genesis_chunked round-trips the genesis doc
+            g = await rpc.call("genesis_chunked", chunk=0)
+            doc = json.loads(base64.b64decode(g["data"]))
+            assert doc["chain_id"] == "rpc-chain"
+
+            # unsafe routes are NOT served without rpc.unsafe
+            from tendermint_tpu.rpc.core import RPCError
+            with pytest.raises(RPCError):
+                await rpc.call("unsafe_flush_mempool")
+
+            # debug dump against the live node (in a thread: the CLI's
+            # blocking HTTP must not stall the node's own event loop)
+            out_dir = str(tmp_path / "bundle")
+            rc = await asyncio.to_thread(cmd_debug, _ns(
+                home=home, output_dir=out_dir, action="dump",
+                rpc_laddr=f"tcp://127.0.0.1:{node.rpc_server.bound_port}",
+                pid=0))
+            assert rc == 0
+            for f in ("status.json", "dump_consensus_state.json",
+                      "config.toml", "wal_tail.jsonl"):
+                assert os.path.exists(os.path.join(out_dir, f)), f
+            with open(os.path.join(out_dir, "dump_consensus_state.json")) as f:
+                bundle = json.load(f)
+            assert "round_state" in bundle["result"]
+            # the WAL tail alone shows consensus progress (wedge diagnosis)
+            with open(os.path.join(out_dir, "wal_tail.jsonl")) as f:
+                types = [json.loads(line)["type"] for line in f]
+            assert "end_height" in types
+
+            await rpc.close()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+    # offline replay over the same home: handshake + WAL tail
+    rc = cmd_replay(_ns(home=home, console=False))
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "handshake replayed chain to height" in out
+
+    # compact-db over the sqlite stores
+    rc = cmd_compact_db(_ns(home=home))
+    assert rc == 0
+    assert "blockstore.db" in capsys.readouterr().out
+
+
+def test_reindex_event(tmp_path, capsys):
+    from tendermint_tpu.cmd import cmd_reindex_event
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    node = _mk_node(tmp_path, backend="sqlite")
+    home = node.config.root_dir
+    node.config.save()
+
+    async def run():
+        await node.start()
+        try:
+            rpc = HTTPClient(f"http://127.0.0.1:{node.rpc_server.bound_port}")
+            await rpc.call("broadcast_tx_sync",
+                           tx=base64.b64encode(b"ridx=1").decode())
+            for _ in range(600):
+                st = await rpc.status()
+                if int(st["sync_info"]["latest_block_height"]) >= 3:
+                    break
+                await asyncio.sleep(0.05)
+            await rpc.close()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+    rc = cmd_reindex_event(_ns(home=home))
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "reindexed" in out and "reindexed 0" not in out

@@ -49,11 +49,11 @@ def test_pex_wire_round_trip():
     assert kind == "request"
 
 
-def _mk_switch(seed, book=None, target=10):
+def _mk_switch(seed, book=None, target=10, **pex_kw):
     nk = NodeKey(crypto.Ed25519PrivKey.generate(seed))
     er = EchoReactor()
     pex = PEXReactor(book or AddrBook(), target_outbound=target,
-                     ensure_interval=0.1, request_interval=0.2)
+                     ensure_interval=0.1, request_interval=0.2, **pex_kw)
     descs = er.get_channels() + pex.get_channels()
     info = NodeInfo(node_id=nk.id, network="pex-net",
                     channels=bytes(d.id for d in descs))
@@ -88,4 +88,79 @@ def test_pex_discovers_peers_transitively():
         finally:
             for sw in (sw_c, sw_b, sw_a):
                 await sw.stop()
+    asyncio.run(run())
+
+
+def test_addrbook_eviction_under_flood():
+    """Adversarial address flooding: the new bucket is capped; eviction
+    prefers most-failed never-succeeded entries, and proven (old-bucket)
+    addresses are never evicted by floods (addrbook.go eviction)."""
+    from tendermint_tpu.p2p.pex import NEW_BUCKET_CAP
+
+    book = AddrBook(strict=False)
+    good = NetAddress("aa" * 20, "10.0.0.1", 1)
+    book.add_address(good, src_id="me")
+    book.mark_good(good.id)
+    # a failed address is the preferred eviction victim
+    bad = NetAddress("bb" * 20, "10.0.0.2", 2)
+    book.add_address(bad)
+    book.mark_attempt(bad)
+    book.mark_attempt(bad)
+    # flood with unique addresses from one source
+    for i in range(NEW_BUCKET_CAP + 50):
+        a = NetAddress(f"{i:040x}", f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}",
+                       1000 + (i % 1000))
+        book.add_address(a, src_id="attacker")
+    # bounded: never grows past cap + old entries
+    n_new = sum(1 for k in book._addrs.values() if k.bucket == "new")
+    assert n_new <= NEW_BUCKET_CAP
+    assert book._addrs[good.id].bucket == "old"  # survivor
+    assert bad.id not in book._addrs  # most-failed got evicted first
+
+
+def test_addrbook_strict_rejects_unroutable_and_self():
+    book = AddrBook(strict=True)
+    book.add_our_address("cc" * 20)
+    assert not book.add_address(NetAddress("cc" * 20, "1.2.3.4", 1))  # self
+    assert not book.add_address(NetAddress("dd" * 20, "0.0.0.0", 1))
+    assert not book.add_address(NetAddress("ee" * 20, "", 1))
+    assert book.add_address(NetAddress("ff" * 20, "127.0.0.1", 1))
+
+
+def test_seed_mode_serves_and_disconnects():
+    """A seed-mode node hands inbound peers an address selection and hangs
+    up; its crawler re-dials book addresses to keep them fresh
+    (pex_reactor.go seed branch + crawlPeersRoutine)."""
+    from tests.test_pex import _mk_switch  # self-import for clarity
+
+    async def run():
+        # seed knows A; client dials seed, must learn A and get disconnected
+        sw_a, pex_a, nk_a = _mk_switch(b"\xe1" * 32)
+        sw_seed, pex_seed, nk_seed = _mk_switch(
+            b"\xe2" * 32, seed_mode=True, seed_disconnect_wait=0.3)
+        sw_c, pex_c, nk_c = _mk_switch(b"\xe3" * 32)
+        for sw in (sw_a, sw_seed, sw_c):
+            await sw.start()
+        addr_a = await sw_a.listen("127.0.0.1", 0)
+        addr_seed = await sw_seed.listen("127.0.0.1", 0)
+        await sw_c.listen("127.0.0.1", 0)
+        try:
+            pex_seed.book.add_address(addr_a, src_id="op")
+            assert await sw_c.dial_peer(addr_seed)
+            # the client's ensure-peers loop requests; the seed answers
+            for _ in range(600):
+                if pex_c.book.has(nk_a.id):
+                    break
+                await asyncio.sleep(0.02)
+            assert pex_c.book.has(nk_a.id), "client never learned A from seed"
+            # and the seed hangs up shortly after serving
+            for _ in range(600):
+                if nk_seed.id not in sw_c.peers:
+                    break
+                await asyncio.sleep(0.02)
+            assert nk_seed.id not in sw_c.peers, "seed kept the conn open"
+        finally:
+            for sw in (sw_c, sw_seed, sw_a):
+                await sw.stop()
+
     asyncio.run(run())

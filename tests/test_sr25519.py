@@ -1,0 +1,93 @@
+"""sr25519 (schnorrkel/ristretto255) — reference crypto/sr25519/pubkey.go:10.
+
+Validates the ristretto255 group against RFC 9496 Appendix A vectors and the
+schnorrkel sign/verify round trip with adversarial mutations.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto import pubkey_from_type_and_bytes, sr25519
+
+# RFC 9496 A.1: encodings of B, 2B (independent pin of the group encoding)
+GEN_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+]
+
+# RFC 9496 A.2: strings that MUST fail decoding
+BAD_ENCODINGS = [
+    # non-canonical field element
+    "00ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    # negative field element
+    "0100000000000000000000000000000000000000000000000000000000000000",
+    "01ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    # non-square x^2
+    "26948d35ca62e643e26a83177332e6b6afeb9d08e4268b650f1f5bbd8d81d371",
+]
+
+
+def test_ristretto_generator_multiples():
+    from tendermint_tpu.crypto.ed25519 import P, _pt_add
+
+    base = (sr25519._B[0], sr25519._B[1], 1,
+            sr25519._B[0] * sr25519._B[1] % P)
+    acc = (0, 1, 1, 0)
+    for expected in GEN_MULTIPLES:
+        enc = sr25519.ristretto_encode(acc)
+        assert enc.hex() == expected
+        # decode returns a point that re-encodes identically
+        pt = sr25519.ristretto_decode(enc)
+        assert pt is not None and sr25519.ristretto_encode(pt) == enc
+        acc = _pt_add(acc, base)
+
+
+def test_ristretto_bad_encodings_rejected():
+    for bad in BAD_ENCODINGS:
+        assert sr25519.ristretto_decode(bytes.fromhex(bad)) is None, bad
+
+
+def test_sign_verify_round_trip():
+    sk = sr25519.Sr25519PrivKey.generate(b"\x11" * 32)
+    pk = sk.pub_key()
+    msg = b"sr25519 vote sign bytes"
+    sig = sk.sign(msg)
+    assert len(sig) == 64 and sig[63] & 128
+    assert pk.verify_signature(msg, sig)
+    # reference test mutation (sr25519_test.go): flip one bit
+    bad = bytearray(sig)
+    bad[7] ^= 1
+    assert not pk.verify_signature(msg, bytes(bad))
+    assert not pk.verify_signature(msg + b"x", sig)
+    # missing schnorrkel marker bit
+    nomark = bytearray(sig)
+    nomark[63] &= 127
+    assert not pk.verify_signature(msg, bytes(nomark))
+    # wrong key
+    other = sr25519.Sr25519PrivKey.generate(b"\x12" * 32).pub_key()
+    assert not other.verify_signature(msg, sig)
+
+
+def test_registry_and_address():
+    sk = sr25519.Sr25519PrivKey.generate(b"\x13" * 32)
+    pk = pubkey_from_type_and_bytes("sr25519", sk.pub_key().bytes())
+    assert pk.address() == sk.pub_key().address()
+    assert len(pk.address()) == 20
+    sig = sk.sign(b"m")
+    assert pk.verify_signature(b"m", sig)
+
+
+def test_params_accept_sr25519():
+    from tendermint_tpu.types.params import (
+        ValidatorParams,
+        default_consensus_params,
+    )
+
+    p = default_consensus_params()
+    p.validator = ValidatorParams(["ed25519", "sr25519"])
+    p.validate_basic()
+    bad = default_consensus_params()
+    bad.validator = ValidatorParams(["bogus"])
+    with pytest.raises(ValueError):
+        bad.validate_basic()

@@ -221,3 +221,30 @@ class TestVerifyCommit:
         trusted = ValidatorSet(vals[:3])  # none of the trusted signed
         with pytest.raises(ErrNotEnoughVotingPowerSigned):
             trusted.verify_commit_light_trusting(CHAIN_ID, commit, (1, 3))
+
+
+def test_from_existing_preserves_proposer_rotation():
+    """(validator_set.go ValidatorSetFromExistingValidators) rebuilding a set
+    from live RPC data must NOT re-run NewValidatorSet's increment — the
+    statesync e2e manifest caught a synced node disagreeing about every
+    proposer and rejecting all proposals."""
+    vals, _ = make_vals(5, power=10)
+    # unequal powers so rotation is non-trivial
+    for i, v in enumerate(vals):
+        v.voting_power = 10 + i
+    vs = ValidatorSet(vals)
+    for _ in range(7):
+        vs.increment_proposer_priority(1)
+    rebuilt = ValidatorSet.from_existing(
+        [v.copy() for v in vs.validators])
+    assert rebuilt.get_proposer().address == vs.get_proposer().address
+    # and the NEXT rotations agree too
+    a, b = vs.copy(), rebuilt.copy()
+    for _ in range(10):
+        a.increment_proposer_priority(1)
+        b.increment_proposer_priority(1)
+        assert a.get_proposer().address == b.get_proposer().address
+    # the plain constructor (NewValidatorSet) is NOT rotation-preserving
+    fresh = ValidatorSet([v.copy() for v in vs.validators])
+    assert [v.proposer_priority for v in fresh.validators] != \
+        [v.proposer_priority for v in vs.validators]
