@@ -167,22 +167,27 @@ def _assemble_blocks(template, diff_cols, diff_vals, mlen, r_b, a_b):
 
 
 @partial(jax.jit, static_argnums=())
-def _verify_sparse_stream_kernel(template, diff_cols, diff_vals, mlen,
+def _verify_sparse_stream_kernel(templates, diff_cols, diff_vals, mlen,
                                  r_b, a_b, s_b):
     """Scan the verify kernel over K chunks, assembling preimage blocks
-    on-device from the sparse wire format.
+    on-device from the sparse wire format. Each chunk carries its OWN
+    template (a fast-sync window holds several commits whose height /
+    block_id / chain bytes differ ACROSS commits but are constant within
+    one — per-chunk templates keep the diff-column set to just the
+    per-signature bytes).
 
-    diff_vals (K, C, B, 128) u8; mlen (K, B, 128) i32;
-    r_b/a_b/s_b (K, 32, B, 128) u8; template (MLEN,) u8; diff_cols (C,) i32.
+    templates (K, MLEN) u8; diff_cols (C,) i32; diff_vals (K, C, B, 128) u8;
+    mlen (K, B, 128) i32; r_b/a_b/s_b (K, 32, B, 128) u8.
     """
     def step(_, x):
-        dv, ml, rb, ab, sb = x
-        blocks, nb = _assemble_blocks(template, diff_cols, dv, ml, rb, ab)
+        tpl, dv, ml, rb, ab, sb = x
+        blocks, nb = _assemble_blocks(tpl, diff_cols, dv, ml, rb, ab)
         sw = sb.reshape((8, 4) + sb.shape[1:]).astype(jnp.uint32)
         s_words = sw[:, 0] | (sw[:, 1] << 8) | (sw[:, 2] << 16) | (sw[:, 3] << 24)
         return None, _verify_kernel.__wrapped__(blocks, nb, s_words)
 
-    _, out = jax.lax.scan(step, None, (diff_vals, mlen, r_b, a_b, s_b))
+    _, out = jax.lax.scan(step, None,
+                          (templates, diff_vals, mlen, r_b, a_b, s_b))
     return out
 
 
@@ -217,30 +222,42 @@ def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
     """Pack a same-bucket batch into the sparse wire format, or return None
     when the messages are too dissimilar for it to pay.
 
+    Each scan chunk gets its own template (its first row): a fast-sync
+    window concatenates several commits whose height/block_id bytes are
+    constant WITHIN a commit but differ across them — per-chunk templates
+    keep the diff-column union near the per-signature minimum.
+
     Returns (device_args tuple for _verify_sparse_stream_kernel, ok mask).
     """
     n = len(pks)
     mlens = np.array(list(map(len, msgs)), dtype=np.int64)
     bucket = _nblk_bucket(int(mlens.max()))
     mlen_max = bucket * 128 - 64
-    arr = np.zeros((n, mlen_max), dtype=np.uint8)
+    k = -(-n // chunk)
+    pad = k * chunk
+    arr = np.zeros((pad, mlen_max), dtype=np.uint8)
     if n and mlens.max() == mlens.min():
         ml = int(mlens[0])
         if ml:
-            arr[:, :ml] = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(n, ml)
+            arr[:n, :ml] = np.frombuffer(
+                b"".join(msgs), dtype=np.uint8).reshape(n, ml)
     else:
         flat_src = np.frombuffer(b"".join(msgs), dtype=np.uint8)
         starts = np.zeros(n, dtype=np.int64)
         np.cumsum(mlens[:-1], out=starts[1:])
-        within = np.arange(flat_src.shape[0], dtype=np.int64) - np.repeat(starts, mlens)
+        within = (np.arange(flat_src.shape[0], dtype=np.int64)
+                  - np.repeat(starts, mlens))
         dst = np.repeat(np.arange(n, dtype=np.int64) * mlen_max, mlens) + within
         arr.reshape(-1)[dst] = flat_src
-    diff = (arr != arr[0]).any(axis=0)
+    templates = arr[::chunk].copy()                      # (k, MLEN)
+    if pad > n:  # padded rows mirror their template: no diff contribution
+        arr[n:] = templates[-1]
+    tiled = np.repeat(templates, chunk, axis=0)          # (pad, MLEN)
+    diff = (arr != tiled).any(axis=0)
     cols = np.nonzero(diff)[0].astype(np.int32)
     if cols.shape[0] > MAX_SPARSE_COLS:
         return None
-    template = arr[0].copy()
-    template[cols] = 0  # diff columns are fully per-item
+    templates[:, cols] = 0  # diff columns are fully per-item
     # pad C to a bucket so the kernel compiles once per bucket, not per
     # batch; padding duplicates column 0 (same value rewritten — harmless)
     c_pad = next(c for c in (4, 8, 16, 32, 64, MAX_SPARSE_COLS)
@@ -248,7 +265,7 @@ def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
     if c_pad > cols.shape[0]:
         cols = np.concatenate(
             [cols, np.zeros(c_pad - cols.shape[0], np.int32)])
-    diff_vals = np.ascontiguousarray(arr[:, cols])  # (n, C)
+    diff_vals = np.ascontiguousarray(arr[:, cols])       # (pad, C)
 
     pk_lens = np.array(list(map(len, pks)), dtype=np.int64)
     sig_lens = np.array(list(map(len, sigs)), dtype=np.int64)
@@ -265,13 +282,10 @@ def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
     pk_arr = np.frombuffer(b"".join(pk_l), dtype=np.uint8).reshape(n, 32)
     ok &= _s_lt_l(s_arr)
 
-    k = -(-n // chunk)
-    pad = k * chunk
     if pad > n:
         r_arr = np.pad(r_arr, ((0, pad - n), (0, 0)))
         pk_arr = np.pad(pk_arr, ((0, pad - n), (0, 0)))
         s_arr = np.pad(s_arr, ((0, pad - n), (0, 0)))
-        diff_vals = np.pad(diff_vals, ((0, pad - n), (0, 0)))
         mlens = np.pad(mlens, (0, pad - n))
     b = chunk // LANE
 
@@ -281,7 +295,7 @@ def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
         ).reshape(k, width, b, LANE)
 
     args = (
-        jnp.asarray(template),
+        jnp.asarray(templates),
         jnp.asarray(cols),
         to_chunks(diff_vals, diff_vals.shape[1]),
         mlens.astype(np.int32).reshape(k, b, LANE),
