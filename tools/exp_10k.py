@@ -1,0 +1,100 @@
+"""Experiment: where do the 21 us/sig go in the 10k commit-shaped path?
+
+Variants over the same 6-commit x 10,240-validator workload:
+  V1 window=3 (2 dispatches of 15 chunks)  -- current bench shape
+  V2 window=6 (1 dispatch of 30 chunks)
+  V3 window=2 (3 dispatches of 10 chunks)
+Each timed with per-window stage split (pack / dispatch+fetch).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+from bench import _mk_val_set, _sign_commit
+from tendermint_tpu.crypto.ed25519_jax import verify as V
+
+CHUNK = 2048
+
+
+def main():
+    n_vals, n_commits = 10240, 6
+    t0 = time.perf_counter()
+    vs, keys = _mk_val_set(n_vals)
+    chain = "bench-10k"
+    commits = [_sign_commit(vs, keys, h, chain)[0]
+               for h in range(1, n_commits + 1)]
+    per_commit = []
+    for c in commits:
+        pks = [v.pub_key.bytes() for v in vs.validators]
+        msgs = [c.vote_sign_bytes(chain, i) for i in range(n_vals)]
+        sigs = [cs.signature for cs in c.signatures]
+        per_commit.append((pks, msgs, sigs))
+    print(f"setup {time.perf_counter()-t0:.1f}s", flush=True)
+
+    def flat(cs):
+        return ([p for c in cs for p in c[0]],
+                [m for c in cs for m in c[1]],
+                [s for c in cs for s in c[2]])
+
+    # inspect sparse format stats for the window=3 shape
+    pks, msgs, sigs = flat(per_commit[:3])
+    sp = V.prepare_sparse_stream(pks, msgs, sigs, CHUNK)
+    assert sp is not None
+    args, ok = sp
+    total_bytes = sum(np.asarray(a).nbytes for a in args)
+    print(f"window=3: K={args[2].shape[0]} C_pad={args[1].shape[0]} "
+          f"wire={total_bytes/2**20:.2f} MB "
+          f"({total_bytes/len(pks):.1f} B/sig incl cached pk "
+          f"{np.asarray(args[5]).nbytes/2**20:.2f} MB)", flush=True)
+
+    for label, window in (("V1 window=3", 3), ("V2 window=6", 6),
+                          ("V3 window=2", 2)):
+        def run_pass():
+            t_pack = t_disp = 0.0
+            for i in range(0, n_commits, window):
+                pks, msgs, sigs = flat(per_commit[i:i + window])
+                t0 = time.perf_counter()
+                sp = V.prepare_sparse_stream(pks, msgs, sigs, CHUNK)
+                args, ok = sp
+                t1 = time.perf_counter()
+                out = np.asarray(V._verify_sparse_stream_kernel(*args))
+                assert out.reshape(-1)[:len(pks)].all() and ok.all()
+                t2 = time.perf_counter()
+                t_pack += t1 - t0
+                t_disp += t2 - t1
+            return t_pack, t_disp
+
+        t0 = time.perf_counter()
+        run_pass()  # compile + pk cache warm
+        print(f"{label}: warm pass {time.perf_counter()-t0:.1f}s", flush=True)
+        best = (1e9, 0, 0)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            tp, td = run_pass()
+            tt = time.perf_counter() - t0
+            if tt < best[0]:
+                best = (tt, tp, td)
+        tt, tp, td = best
+        n = n_commits * n_vals
+        print(f"{label}: total {tt*1e3:7.1f} ms  pack {tp*1e3:6.1f}  "
+              f"dispatch+fetch {td*1e3:7.1f}  -> {n/tt:8.0f} sigs/s "
+              f"({n/tt/5888:.2f}x est)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

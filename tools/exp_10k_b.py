@@ -1,0 +1,104 @@
+"""Experiment B: dispatch sizing + pipelining for the 10k commit path.
+
+  V4 window=1 serial (6 dispatches of 5 chunks)
+  V5 window=2, double-buffered: worker thread packs+dispatches window i+1
+     while the main thread fetches window i
+  V6 window=1, 2-deep pipeline
+  V7 window=2, chunk=4096 (K=5 per dispatch)
+"""
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+from bench import _mk_val_set, _sign_commit
+from tendermint_tpu.crypto.ed25519_jax import verify as V
+
+
+def main():
+    n_vals, n_commits = 10240, 6
+    vs, keys = _mk_val_set(n_vals)
+    chain = "bench-10k"
+    commits = [_sign_commit(vs, keys, h, chain)[0]
+               for h in range(1, n_commits + 1)]
+    per_commit = []
+    for c in commits:
+        pks = [v.pub_key.bytes() for v in vs.validators]
+        msgs = [c.vote_sign_bytes(chain, i) for i in range(n_vals)]
+        sigs = [cs.signature for cs in c.signatures]
+        per_commit.append((pks, msgs, sigs))
+    print("setup done", flush=True)
+
+    def flat(cs):
+        return ([p for c in cs for p in c[0]],
+                [m for c in cs for m in c[1]],
+                [s for c in cs for s in c[2]])
+
+    n = n_commits * n_vals
+    pool = ThreadPoolExecutor(max_workers=2)
+
+    def serial(window, chunk):
+        def run():
+            for i in range(0, n_commits, window):
+                pks, msgs, sigs = flat(per_commit[i:i + window])
+                args, ok = V.prepare_sparse_stream(pks, msgs, sigs, chunk)
+                out = np.asarray(V._verify_sparse_stream_kernel(*args))
+                assert out.reshape(-1)[:len(pks)].all() and ok.all()
+        return run
+
+    def pipelined(window, chunk, depth=2):
+        def run():
+            def submit(i):
+                pks, msgs, sigs = flat(per_commit[i:i + window])
+                args, ok = V.prepare_sparse_stream(pks, msgs, sigs, chunk)
+                return V._verify_sparse_stream_kernel(*args), ok, len(pks)
+
+            idxs = list(range(0, n_commits, window))
+            futs = []
+            for i in idxs[:depth]:
+                futs.append(pool.submit(submit, i))
+            k = depth
+            for _ in idxs:
+                fut = futs.pop(0)
+                dev, ok, npk = fut.result()
+                if k < len(idxs):
+                    futs.append(pool.submit(submit, idxs[k]))
+                    k += 1
+                out = np.asarray(dev)
+                assert out.reshape(-1)[:npk].all() and ok.all()
+        return run
+
+    cases = [
+        ("V4 window=1 serial", serial(1, 2048)),
+        ("V5 window=2 pipelined", pipelined(2, 2048)),
+        ("V6 window=1 pipelined", pipelined(1, 2048)),
+        ("V7 window=2 chunk=4096", serial(2, 4096)),
+        ("V3r window=2 serial (rerun)", serial(2, 2048)),
+    ]
+    for label, fn in cases:
+        t0 = time.perf_counter()
+        fn()
+        print(f"{label}: warm {time.perf_counter()-t0:.1f}s", flush=True)
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        print(f"{label}: {best*1e3:7.1f} ms -> {n/best:8.0f} sigs/s "
+              f"({n/best/5888:.2f}x est)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

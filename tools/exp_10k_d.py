@@ -1,0 +1,84 @@
+"""Head-to-head under identical relay conditions: V5-style manual pipeline
+vs the integrated _verify_segmented, interleaved A/B/A/B to cancel drift."""
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+from bench import _mk_val_set, _sign_commit
+from tendermint_tpu.crypto.ed25519_jax import verify as V
+
+
+def main():
+    n_vals, n_commits = 10240, 6
+    vs, keys = _mk_val_set(n_vals)
+    chain = "bench-10k"
+    commits = [_sign_commit(vs, keys, h, chain)[0]
+               for h in range(1, n_commits + 1)]
+    per_commit = []
+    for c in commits:
+        pks = [v.pub_key.bytes() for v in vs.validators]
+        msgs = [c.vote_sign_bytes(chain, i) for i in range(n_vals)]
+        sigs = [cs.signature for cs in c.signatures]
+        per_commit.append((pks, msgs, sigs))
+    apks = [p for c in per_commit for p in c[0]]
+    amsgs = [m for c in per_commit for m in c[1]]
+    asigs = [s for c in per_commit for s in c[2]]
+    n = n_commits * n_vals
+    pool = ThreadPoolExecutor(max_workers=2)
+    print("setup done", flush=True)
+
+    def flat(cs):
+        return ([p for c in cs for p in c[0]],
+                [m for c in cs for m in c[1]],
+                [s for c in cs for s in c[2]])
+
+    def v5():  # manual: window=2 commits, depth-2 pipeline
+        def submit(i):
+            pks, msgs, sigs = flat(per_commit[i:i + 2])
+            args, ok = V.prepare_sparse_stream(pks, msgs, sigs, 2048)
+            return V._verify_sparse_stream_kernel(*args), ok, len(pks)
+
+        idxs = [0, 2, 4]
+        futs = [pool.submit(submit, i) for i in idxs[:2]]
+        k = 2
+        for _ in idxs:
+            dev, ok, npk = futs.pop(0).result()
+            if k < len(idxs):
+                futs.append(pool.submit(submit, idxs[k]))
+                k += 1
+            out = np.asarray(dev)
+            assert out.reshape(-1)[:npk].all() and ok.all()
+
+    def integrated():
+        assert V.batch_verify_stream(apks, amsgs, asigs, chunk=2048).all()
+
+    v5()
+    integrated()
+    ts = {"v5": [], "integrated": []}
+    for _ in range(4):
+        for name, fn in (("v5", v5), ("integrated", integrated)):
+            t0 = time.perf_counter()
+            fn()
+            ts[name].append(time.perf_counter() - t0)
+    for name, arr in ts.items():
+        best = min(arr)
+        print(f"{name:12s} min {best*1e3:7.1f} ms  med "
+              f"{sorted(arr)[len(arr)//2]*1e3:7.1f} ms -> {n/best:8.0f} sigs/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Timeline instrumentation of the segmented pipeline + fetch batching test."""
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+from bench import _mk_val_set, _sign_commit
+from tendermint_tpu.crypto.ed25519_jax import verify as V
+
+
+def main():
+    n_vals, n_commits = 10240, 6
+    vs, keys = _mk_val_set(n_vals)
+    chain = "bench-10k"
+    commits = [_sign_commit(vs, keys, h, chain)[0]
+               for h in range(1, n_commits + 1)]
+    pks, msgs, sigs = [], [], []
+    for c in commits:
+        pks += [v.pub_key.bytes() for v in vs.validators]
+        msgs += [c.vote_sign_bytes(chain, i) for i in range(n_vals)]
+        sigs += [cs.signature for cs in c.signatures]
+    n = len(pks)
+    pool = ThreadPoolExecutor(max_workers=2)
+    print("setup done", flush=True)
+
+    segs = [(0, 20480), (20480, 40960), (40960, 61440)]
+
+    def run(fetch_mode):
+        t_start = time.perf_counter()
+        ev = []
+
+        def submit(a, b):
+            t0 = time.perf_counter() - t_start
+            args, ok = V.prepare_sparse_stream(pks[a:b], msgs[a:b],
+                                               sigs[a:b], 2048)
+            t1 = time.perf_counter() - t_start
+            dev = V._verify_sparse_stream_kernel(*args)
+            t2 = time.perf_counter() - t_start
+            ev.append(("pack+disp", a, round(t0 * 1e3), round(t1 * 1e3),
+                       round(t2 * 1e3)))
+            return dev, ok
+
+        futs = [pool.submit(submit, a, b) for a, b in segs]
+        if fetch_mode == "per-seg":
+            for i, f in enumerate(futs):
+                dev, ok = f.result()
+                t0 = time.perf_counter() - t_start
+                out = np.asarray(dev)
+                t1 = time.perf_counter() - t_start
+                ev.append(("fetch", i, round(t0 * 1e3), round(t1 * 1e3)))
+                assert out.reshape(-1).all() and ok.all()
+        else:
+            devs = [f.result() for f in futs]
+            t0 = time.perf_counter() - t_start
+            outs = jax.device_get([d for d, _ in devs])
+            t1 = time.perf_counter() - t_start
+            ev.append(("batched-fetch", -1, round(t0 * 1e3), round(t1 * 1e3)))
+            for (d, ok), out in zip(devs, outs):
+                assert np.asarray(out).reshape(-1).all() and ok.all()
+        total = time.perf_counter() - t_start
+        return total, ev
+
+    run("per-seg")  # warm
+    for mode in ("per-seg", "batched", "per-seg", "batched"):
+        total, ev = run(mode)
+        print(f"{mode:8s} total {total*1e3:7.1f} ms -> {n/total:8.0f} sigs/s")
+        for e in ev:
+            print("   ", e)
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Quantify axon-relay dispatch costs: fixed per-call, per-array, per-byte.
+
+Times a trivial jitted reduction over (a) one big array, (b) the same bytes
+split across 7 arrays, (c) varying total bytes — always with perturbed
+inputs and a fetched output so the relay cannot serve a cached result.
+
+Usage: python tools/relay_probe.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+
+
+@jax.jit
+def one(a):
+    return jnp.sum(a, dtype=jnp.int32)
+
+
+@jax.jit
+def seven(a, b, c, d, e, f, g):
+    return (jnp.sum(a, dtype=jnp.int32) + jnp.sum(b, dtype=jnp.int32)
+            + jnp.sum(c, dtype=jnp.int32) + jnp.sum(d, dtype=jnp.int32)
+            + jnp.sum(e, dtype=jnp.int32) + jnp.sum(f, dtype=jnp.int32)
+            + jnp.sum(g, dtype=jnp.int32))
+
+
+def timed(fn, mk_args, runs=5):
+    ts = []
+    for i in range(runs):
+        args = mk_args(i)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sorted(ts)[len(ts) // 2]
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    for total_mb in (0.125, 1, 4):
+        nbytes = int(total_mb * (1 << 20))
+        base = rng.integers(0, 255, nbytes, dtype=np.uint8)
+
+        def mk_one(i):
+            a = base.copy()
+            a[0] = i  # perturb so the relay can't cache
+            return (a,)
+
+        def mk_seven(i):
+            a = base.copy()
+            a[0] = i
+            return tuple(a[j * (nbytes // 7):(j + 1) * (nbytes // 7)].copy()
+                         for j in range(7))
+
+        one(*mk_one(99))          # compile
+        seven(*mk_seven(99))      # compile
+        t1, m1 = timed(one, mk_one)
+        t7, m7 = timed(seven, mk_seven)
+        print(f"{total_mb:6.3f} MB  one-array min/med {t1*1e3:7.1f}/{m1*1e3:7.1f} ms"
+              f"   seven-array min/med {t7*1e3:7.1f}/{m7*1e3:7.1f} ms", flush=True)
+
+    # zero-transfer dispatch cost: input already on device, output scalar
+    dev = jax.device_put(base)
+
+    def mk_dev(i):
+        return (dev,)
+
+    t0, m0 = timed(one, mk_dev)
+    print(f"resident-input dispatch min/med {t0*1e3:7.1f}/{m0*1e3:7.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Probe 2: can relay transfers be parallelized, and does dispatch overlap?
+
+1. serial jax.device_put of 4 x 1MB vs threaded device_put of the same
+2. device_put of one 4MB buffer (baseline bandwidth)
+3. two async heavy-compute dispatches back-to-back: pipelined or serial?
+"""
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+
+rng = np.random.default_rng(0)
+MB = 1 << 20
+
+
+def bench(label, fn, runs=4):
+    ts = []
+    for i in range(runs):
+        t0 = time.perf_counter()
+        fn(i)
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:46s} min {min(ts)*1e3:7.1f} ms  med {sorted(ts)[len(ts)//2]*1e3:7.1f} ms",
+          flush=True)
+
+
+def main():
+    chunks = [rng.integers(0, 255, MB, dtype=np.uint8) for _ in range(4)]
+    big = rng.integers(0, 255, 4 * MB, dtype=np.uint8)
+    pool = ThreadPoolExecutor(max_workers=4)
+
+    def serial_put(i):
+        for c in chunks:
+            c[0] = i
+            jax.device_put(c).block_until_ready()
+
+    def threaded_put(i):
+        for c in chunks:
+            c[0] = i
+        futs = [pool.submit(lambda a: jax.device_put(a).block_until_ready(), c)
+                for c in chunks]
+        [f.result() for f in futs]
+
+    def one_put(i):
+        big[0] = i
+        jax.device_put(big).block_until_ready()
+
+    bench("serial device_put 4x1MB", serial_put)
+    bench("threaded device_put 4x1MB", threaded_put)
+    bench("single device_put 4MB", one_put)
+
+    # heavy compute kernel ~100ms device: iterate matmul
+    @jax.jit
+    def heavy(a):
+        def step(x, _):
+            return jnp.tanh(x @ x), None
+        out, _ = jax.lax.scan(step, a, None, length=40)
+        return jnp.sum(out)
+
+    a = rng.standard_normal((1024, 1024), dtype=np.float32)
+    heavy(a).block_until_ready()
+
+    def one_heavy(i):
+        a[0, 0] = i
+        np.asarray(heavy(a))
+
+    def two_heavy_async(i):
+        a[0, 0] = i
+        b = a.copy()
+        b[0, 1] = i + 1
+        r1 = heavy(a)
+        r2 = heavy(b)
+        np.asarray(r1), np.asarray(r2)
+
+    bench("one heavy dispatch", one_heavy)
+    bench("two heavy dispatches (async overlap?)", two_heavy_async)
+
+    # dispatch on resident data (no transfer): pure fixed+compute
+    da = jax.device_put(a)
+
+    def resident_heavy(i):
+        np.asarray(heavy(da))
+
+    bench("heavy dispatch, resident input", resident_heavy)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Probe 3: is the relay delta-compressing near-identical transfers?
+
+Times a small-compute kernel over 4MB payloads:
+  A. one dispatch, fresh random payload each run
+  B. two async dispatches, both fresh independent random payloads
+  C. two async dispatches, second = copy of first with 2 bytes changed
+  D. one dispatch, payload = previous run's payload with 2 bytes changed
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+
+rng = np.random.default_rng(0)
+MB = 1 << 20
+N = 4 * MB
+
+
+@jax.jit
+def touch(a):
+    return jnp.sum(a, dtype=jnp.int32)
+
+
+def bench(label, fn, runs=4):
+    ts = []
+    for i in range(runs):
+        t0 = time.perf_counter()
+        fn(i)
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:52s} min {min(ts)*1e3:7.1f} ms  med {sorted(ts)[len(ts)//2]*1e3:7.1f} ms",
+          flush=True)
+
+
+def main():
+    touch(rng.integers(0, 255, N, dtype=np.uint8))  # compile
+
+    def fresh_one(i):
+        a = rng.integers(0, 255, N, dtype=np.uint8)
+        np.asarray(touch(a))
+
+    def fresh_two(i):
+        a = rng.integers(0, 255, N, dtype=np.uint8)
+        b = rng.integers(0, 255, N, dtype=np.uint8)
+        r1, r2 = touch(a), touch(b)
+        np.asarray(r1), np.asarray(r2)
+
+    def near_two(i):
+        a = rng.integers(0, 255, N, dtype=np.uint8)
+        b = a.copy()
+        b[0] ^= 1
+        b[N // 2] ^= 1
+        r1, r2 = touch(a), touch(b)
+        np.asarray(r1), np.asarray(r2)
+
+    base = rng.integers(0, 255, N, dtype=np.uint8)
+
+    def delta_one(i):
+        base[i] ^= 1
+        base[N // 2 + i] ^= 1
+        np.asarray(touch(base))
+
+    bench("A one dispatch, fresh 4MB", fresh_one)
+    bench("B two dispatches, independent 4MB each", fresh_two)
+    bench("C two dispatches, second is near-copy", near_two)
+    bench("D one dispatch, near-copy of previous run", delta_one)
+
+
+if __name__ == "__main__":
+    main()
