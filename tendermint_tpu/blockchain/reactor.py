@@ -26,6 +26,7 @@ from ..state.state import State
 from ..store import BlockStore
 from ..types.basic import BlockID
 from ..types.block import Block
+from ..crypto.batch import BatchVerifier, precomputed_verdicts
 from ..types.validator_set import verify_commit_light_batched
 from .msgs import (
     BlockRequest,
@@ -50,6 +51,9 @@ class FatalSyncError(Exception):
 # verify/apply at most this many blocks per batch; bounds device batch size
 # (10k validators x 64 blocks = 640k sigs would exceed one comfortable batch)
 VERIFY_WINDOW = 16
+# window precompute engages at/above this many candidate signatures (both
+# planes); below it the per-block path is cheaper and compile-free
+PRECOMPUTE_MIN_SIGS = 2048
 POLL_INTERVAL = 0.01
 STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
@@ -231,9 +235,95 @@ class BlockchainReactor(Reactor):
             block_id = BlockID(blk.hash(), parts_header)
             entries.append((self.state.validators, self.state.chain_id,
                             block_id, blk.header.height, nxt.last_commit))
-        results = verify_commit_light_batched(entries)
 
-        for (blk, peer_id, nxt, npeer_id), err, entry in zip(pairs, results, entries):
+        # Pre-verify the window's OTHER signature plane in the same scope:
+        # apply_block -> validate_block re-checks each block's LastCommit
+        # with the full VerifyCommit predicate (state/validation.py:55,
+        # reference state/validation.go:72). Verified one commit at a time
+        # that is a full-dispatch-latency device call per block; batched
+        # here, the apply loop's verify_commit hits precomputed verdicts and
+        # the whole window costs one device round-trip for BOTH planes.
+        # off-loop: a cold backend compile or a big host batch inside the
+        # loop would stall RPC/p2p liveness for the whole node
+        pre = await asyncio.get_running_loop().run_in_executor(
+            None, self._precompute_last_commit_verdicts, pairs)
+        token = precomputed_verdicts.set(pre) if pre is not None else None
+        try:
+            results = verify_commit_light_batched(entries)
+            await self._apply_window(pairs, results, entries)
+        finally:
+            if token is not None:
+                precomputed_verdicts.reset(token)
+
+    def _precompute_last_commit_verdicts(self, pairs) -> "Optional[dict]":
+        """(pk, sign_bytes, sig) -> verdict for every candidate signature the
+        window will verify — the light entries above AND each block's
+        LastCommit full-commit candidates. Returns None when the window's
+        LastCommits span a validator-set change (the per-block fallback is
+        correct there; _process_window already bounds pairs to one set for
+        the light plane)."""
+        try:
+            return self._precompute_inner(pairs)
+        except Exception as e:
+            # peer data is untrusted here (nothing has validated these
+            # blocks yet): ANY malformed shape — last_commit=None, odd sig
+            # sizes — falls back to the per-block path, whose per-entry
+            # error handling turns bad blocks into pool.redo + punish
+            # instead of wedging the pool routine
+            logger.debug("window precompute skipped: %s", e)
+            return None
+
+    def _precompute_inner(self, pairs) -> "Optional[dict]":
+        first_h = pairs[0][0].header.height
+        # small-net windows (few validators or a short tail) stay on the
+        # per-block path: doubling a tiny batch buys nothing and must not
+        # push it over the device-routing threshold (a cold XLA compile in a
+        # fresh node process would dwarf the verification itself)
+        n_sigs = sum(len(blk.last_commit.signatures) if blk.last_commit else 0
+                     for blk, _p, _n, _np in pairs) * 2
+        if n_sigs < PRECOMPUTE_MIN_SIGS:
+            return None
+        bv = BatchVerifier()
+        keys: List[Tuple[bytes, bytes, bytes]] = []
+
+        def _add(pub, msg, sig):
+            bv.add(pub, msg, sig)
+            keys.append((pub.bytes(), msg, sig))
+
+        for blk, _p, nxt, _np in pairs:
+            # block h's LastCommit was signed by the valset of h-1: the first
+            # window block checks against state.last_validators, later ones
+            # against the (stable) current set
+            vals = (self.state.last_validators if blk.header.height == first_h
+                    else self.state.validators)
+            lc = blk.last_commit
+            if lc is not None and len(lc.signatures):
+                if len(lc.signatures) != vals.size():
+                    return None  # shape mismatch: let validate_block decide
+                sb = lc.vote_sign_bytes_all(self.state.chain_id)
+                for idx, cs in enumerate(lc.signatures):
+                    if not cs.absent():
+                        _add(vals.validators[idx].pub_key, sb[idx],
+                             cs.signature)
+            # the light plane of THIS window (nxt.last_commit rows) shares
+            # the batch: one device call covers both planes. Candidate rule
+            # MUST mirror verify_commit_light_batched (validator_set.py):
+            # for_block sigs keyed by (pk, vote_sign_bytes_all row, sig) —
+            # any divergence makes BatchVerifier miss the precomputed dict
+            # and silently re-dispatch, not mis-verify (all-or-nothing hit)
+            cur = self.state.validators
+            sbn = nxt.last_commit.vote_sign_bytes_all(self.state.chain_id)
+            for idx, cs in enumerate(nxt.last_commit.signatures):
+                if cs.for_block() and idx < cur.size():
+                    _add(cur.validators[idx].pub_key, sbn[idx], cs.signature)
+        if not keys:
+            return None
+        _, verdicts = bv.verify()
+        return {t: bool(v) for t, v in zip(keys, verdicts)}
+
+    async def _apply_window(self, pairs, results, entries) -> None:
+        for (blk, peer_id, nxt, npeer_id), err, entry in zip(
+                pairs, results, entries):
             if err is not None:
                 logger.warning("invalid block/commit at height %d: %s",
                                blk.header.height, err)

@@ -70,6 +70,19 @@ def cmd_start(args) -> int:
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname).1s %(message)s")
+    # persistent XLA compile cache: the batched-verify kernels take minutes
+    # to compile cold; without this every fresh node process pays that on
+    # its first device-routed batch (TMTPU_JAX_CACHE overrides, e.g. the
+    # e2e runner points all subprocess nodes at one shared cache)
+    try:
+        import jax
+
+        cache = os.environ.get("TMTPU_JAX_CACHE") or os.path.join(
+            args.home, ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
     cfg = Config.load(args.home)
     if args.p2p_laddr:
         cfg.p2p.laddr = args.p2p_laddr
@@ -83,6 +96,14 @@ def cmd_start(args) -> int:
     node = Node.default(cfg)
 
     async def run():
+        # SIGUSR1 -> synchronous in-process dump of thread stacks, asyncio
+        # task stacks, round state and peer table — works even when the
+        # event loop is wedged (reference keeps a pprof listener for this,
+        # node/node.go:896; see libs/debugdump.py)
+        from .libs import debugdump
+
+        debugdump.install(args.home, node=node,
+                          loop=asyncio.get_running_loop())
         await node.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -337,8 +358,16 @@ def cmd_debug(args) -> int:
         if not pid:
             print("debug kill: --pid required", file=sys.stderr)
             return 1
-        os.kill(pid, _signal.SIGKILL)
-        print(f"killed pid {pid}")
+        # in-process dump first (debug/kill.go captures goroutine profiles
+        # before the kill): the node's SIGUSR1 handler writes stacks to its
+        # home even when its loop — and therefore RPC — is wedged
+        try:
+            os.kill(pid, _signal.SIGUSR1)
+            _time.sleep(1.0)
+            os.kill(pid, _signal.SIGKILL)
+            print(f"killed pid {pid}")
+        except ProcessLookupError:
+            print(f"pid {pid} already gone")
     return 0
 
 

@@ -144,7 +144,10 @@ class StorageConfig:
 class TxIndexConfig:
     """(config/config.go:1117 TxIndexConfig)"""
 
-    indexer: str = "kv"              # kv | null
+    indexer: str = "kv"              # kv | null | psql (SQL event sink)
+    # connection for indexer="psql" (reference config.go PsqlConn); here a
+    # sqlite path — empty means <data>/events.sqlite (see state/sink.py)
+    psql_conn: str = ""
 
 
 @dataclass
@@ -229,7 +232,7 @@ class Config:
                 raise ValueError("statesync.trust_height must be set")
         if self.fastsync.version not in ("v0",):
             raise ValueError(f"unknown fastsync version {self.fastsync.version!r}")
-        if self.tx_index.indexer not in ("kv", "null"):
+        if self.tx_index.indexer not in ("kv", "null", "psql"):
             raise ValueError(f"unknown indexer {self.tx_index.indexer!r}")
 
     # -- TOML round-trip -----------------------------------------------------

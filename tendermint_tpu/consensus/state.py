@@ -220,11 +220,30 @@ class ConsensusState:
         if parts is not None:
             m.block_size_bytes.set(parts.byte_size)
         m.total_txs.inc(len(block.data.txs))
+        # (reference state.go recordMetrics) byzantine gauges count the
+        # EQUIVOCATING VALIDATORS, not evidence items: LightClientAttack
+        # carries its validator list; DuplicateVote names one validator by
+        # address, resolved against the current set for its power
         byz_power = 0
+        byz_validators = set()
         for ev in block.evidence:
-            for v in getattr(ev, "byzantine_validators", []) or []:
-                byz_power += getattr(v, "voting_power", 0)
-        m.byzantine_validators.set(len(block.evidence))
+            lc_vals = getattr(ev, "byzantine_validators", None)
+            if lc_vals:
+                for v in lc_vals:
+                    if v.address not in byz_validators:  # dedup across items
+                        byz_validators.add(v.address)
+                        byz_power += getattr(v, "voting_power", 0)
+                continue
+            vote_a = getattr(ev, "vote_a", None)
+            if vote_a is not None:
+                addr = vote_a.validator_address
+                if addr not in byz_validators:
+                    byz_validators.add(addr)
+                    if vals is not None:
+                        _, val = vals.get_by_address(addr)
+                        if val is not None:
+                            byz_power += val.voting_power
+        m.byzantine_validators.set(len(byz_validators))
         m.byzantine_validators_power.set(byz_power)
         if self.state.last_block_time_ns:
             m.block_interval_seconds.observe(

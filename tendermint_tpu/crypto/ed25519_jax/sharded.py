@@ -61,7 +61,7 @@ def _sharded_step(mesh: Mesh):
         verdict = _verify_kernel.__wrapped__(blocks, nblk, s_words)
         # (8, B, 128) int32 8-bit limb planes; zero out rejected signatures
         masked = jnp.where(verdict[None], power_limbs, 0)
-        local = jnp.sum(masked, axis=(1, 2))          # (5,) int32
+        local = jnp.sum(masked, axis=(1, 2))          # (POWER_LIMBS,) int32
         total_limbs = jax.lax.psum(local, axis_name=AXIS)
         return verdict, total_limbs
 

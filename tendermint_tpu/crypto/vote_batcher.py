@@ -37,6 +37,13 @@ logger = logging.getLogger("tmtpu.votebatch")
 DEFAULT_MIN_DEVICE_BATCH = 16
 DEFAULT_MAX_BATCH = 1024
 DEFAULT_DEADLINE_S = 0.003
+# consensus liveness bound: if a device flush hasn't produced verdicts in
+# this long (cold XLA compile on a fresh node, relay stall), the batch is
+# re-verified on the host scalar path and later flushes stay host-side
+# until the device call finally completes. Found in the wild: a catchup
+# vote burst on a fresh node dispatched a cold-compile flush and consensus
+# sat at the same height forever awaiting the verdict futures.
+DEFAULT_DEVICE_TIMEOUT_S = 3.0
 _CACHE_CAP = 16384
 
 
@@ -45,10 +52,13 @@ class BatchVoteVerifier:
 
     def __init__(self, min_device_batch: int = DEFAULT_MIN_DEVICE_BATCH,
                  max_batch: int = DEFAULT_MAX_BATCH,
-                 deadline_s: float = DEFAULT_DEADLINE_S):
+                 deadline_s: float = DEFAULT_DEADLINE_S,
+                 device_timeout_s: float = DEFAULT_DEVICE_TIMEOUT_S):
         self.min_device_batch = min_device_batch
         self.max_batch = max_batch
         self.deadline_s = deadline_s
+        self.device_timeout_s = device_timeout_s
+        self._device_warming = False  # a device flush is past its deadline
         self._pending: List[Tuple[bytes, bytes, bytes, bytes, asyncio.Future]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         # strong refs to in-flight flush tasks (event loop keeps only weak
@@ -115,30 +125,54 @@ class BatchVoteVerifier:
         from . import Ed25519PubKey
 
         n = len(batch)
+        loop = asyncio.get_running_loop()
+
+        def _host_verify():
+            return [Ed25519PubKey(pk).verify_signature(m, s)
+                    for _key, pk, m, s, _fut in batch]
+
         try:
-            if n >= self.min_device_batch:
+            if n >= self.min_device_batch and not self._device_warming:
                 from .ed25519_jax import batch_verify_stream
 
                 pks = [b[1] for b in batch]
                 msgs = [b[2] for b in batch]
                 sigs = [b[3] for b in batch]
-                loop = asyncio.get_running_loop()
-                out = await loop.run_in_executor(
+                dev = loop.run_in_executor(
                     None, batch_verify_stream, pks, msgs, sigs)
-                self.stats["device_batches"] += 1
-                self.stats["device_sigs"] += n
-                results = [bool(v) for v in out]
+                try:
+                    out = await asyncio.wait_for(
+                        asyncio.shield(dev), self.device_timeout_s)
+                except asyncio.TimeoutError:
+                    # liveness over throughput: verify THIS batch on host
+                    # now; let the (probably compiling) device call finish
+                    # in the background and re-enable the device path then
+                    self._device_warming = True
+
+                    def _device_ready(f):
+                        self._device_warming = False
+                        if not f.cancelled() and f.exception() is not None:
+                            # consume it: the batch was already host-verified,
+                            # and an unretrieved exception would dump a
+                            # traceback at GC on a consensus-critical node
+                            logger.info("background device flush failed "
+                                        "after timeout fallback: %s",
+                                        f.exception())
+
+                    dev.add_done_callback(_device_ready)
+                    self.stats["device_timeouts"] += 1
+                    self.stats["host_batches"] += 1
+                    self.stats["host_sigs"] += n
+                    results = await loop.run_in_executor(None, _host_verify)
+                else:
+                    self.stats["device_batches"] += 1
+                    self.stats["device_sigs"] += n
+                    results = [bool(v) for v in out]
             else:
                 self.stats["host_batches"] += 1
                 self.stats["host_sigs"] += n
-
-                def _host_verify():
-                    return [Ed25519PubKey(pk).verify_signature(m, s)
-                            for _key, pk, m, s, _fut in batch]
-
                 # off the event loop: even a sub-threshold flush shouldn't
                 # stall peers/timers for ~ms of OpenSSL work
-                loop = asyncio.get_running_loop()
                 results = await loop.run_in_executor(None, _host_verify)
         except Exception as e:  # pragma: no cover - defensive
             logger.exception("vote batch flush failed: %s", e)

@@ -1,0 +1,140 @@
+"""Randomized e2e testnet manifest generator
+(reference test/e2e/generator/generate.go:16-40).
+
+The three hand-written CI manifests are the smoke tier; this module samples
+the combination space — topology x mempool version x privval transport x
+sync mode x late joiners x perturbations x misbehaviors — the way the
+reference's nightly matrix does, because cross-feature bugs live in the
+combinations nobody thought to write down (round 4's statesync proposer bug
+was exactly such a case). Same seed -> same manifests, so a failing nightly
+net is reproducible from its seed.
+
+Usage:
+    python -m tendermint_tpu.e2e.generate --seed 7 --count 4 --output-dir out/
+Each manifest validates against Manifest.from_doc before being written.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+from typing import List, Tuple
+
+from .manifest import Manifest
+
+PERTURBATIONS = ["kill", "restart", "pause", "disconnect"]
+
+
+def _toml_str(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def generate_one(rng: random.Random, idx: int) -> Tuple[str, dict]:
+    """One sampled testnet as a TOML document dict (validated by caller)."""
+    n_validators = rng.choice([2, 3, 4, 4])  # small nets; 4 is the sweet spot
+    doc: dict = {
+        "chain_id": f"gen-{idx}",
+        "load_tx_rate": rng.choice([1, 2, 4]),
+        "wait_blocks": rng.choice([4, 5, 6]),
+        "node": {},
+    }
+    perturb_budget = 2  # bound wall-clock: at most 2 perturbed nodes per net
+    for v in range(n_validators):
+        node = {"mode": "validator"}
+        if rng.random() < 0.5:
+            node["mempool_version"] = "v1"
+        if rng.random() < 0.25:
+            node["privval"] = "tcp"
+        # never perturb validator0: the net must keep making progress while
+        # others flap (with 2 validators any kill halts consensus, so skip)
+        if (v > 0 and n_validators >= 3 and perturb_budget
+                and rng.random() < 0.35):
+            node["perturb"] = [rng.choice(PERTURBATIONS)]
+            perturb_budget -= 1
+        # a lone equivocator needs >=4 validators so the net keeps quorum
+        # and commits the evidence instead of stalling
+        if (n_validators >= 4 and v == n_validators - 1
+                and rng.random() < 0.35 and "perturb" not in node):
+            node["misbehaviors"] = {str(rng.randint(3, 5)): "double-prevote"}
+        doc["node"][f"validator{v}"] = node
+
+    # full nodes: a genesis follower and/or a late joiner (fast sync or
+    # state sync — state_sync requires start_at > 0 per manifest rules)
+    if rng.random() < 0.4:
+        doc["node"]["full0"] = {
+            "mode": "full",
+            "mempool_version": rng.choice(["v0", "v1"]),
+        }
+    if rng.random() < 0.6:
+        joiner = {"mode": "full", "start_at": rng.randint(5, 8)}
+        if rng.random() < 0.5:
+            joiner["state_sync"] = True
+        doc["node"][f"sync{idx}"] = joiner
+    return doc["chain_id"], doc
+
+
+def doc_to_toml(doc: dict) -> str:
+    lines = [f"# generated manifest (tendermint_tpu.e2e.generate)"]
+    for k in ("chain_id", "initial_height", "load_tx_rate", "wait_blocks"):
+        if k in doc:
+            lines.append(f"{k} = {_toml_str(doc[k])}")
+    if doc.get("validators"):
+        lines.append("\n[validators]")
+        for name, power in doc["validators"].items():
+            lines.append(f"{name} = {power}")
+    for name, node in doc.get("node", {}).items():
+        lines.append(f"\n[node.{name}]")
+        for k, v in node.items():
+            if k == "misbehaviors":
+                continue
+            if k == "perturb":
+                lines.append(
+                    f"perturb = [{', '.join(_toml_str(p) for p in v)}]")
+            else:
+                lines.append(f"{k} = {_toml_str(v)}")
+        if "misbehaviors" in node:
+            lines.append(f"[node.{name}.misbehaviors]")
+            for h, m in node["misbehaviors"].items():
+                lines.append(f"{h} = {_toml_str(m)}")
+    return "\n".join(lines) + "\n"
+
+
+def generate(seed: int, count: int = 4) -> List[Tuple[str, Manifest, str]]:
+    """count validated (name, Manifest, toml_text) tuples from one seed."""
+    rng = random.Random(seed)
+    out = []
+    for idx in range(count):
+        name, doc = generate_one(rng, idx)
+        toml_text = doc_to_toml(doc)
+        # round-trip through the TOML parser so the written file is what the
+        # runner will actually load
+        import tomllib
+
+        manifest = Manifest.from_doc(tomllib.loads(toml_text))
+        out.append((name, manifest, toml_text))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="generate randomized e2e testnet manifests")
+    ap.add_argument("--seed", type=int, required=True)
+    ap.add_argument("--count", type=int, default=4)
+    ap.add_argument("--output-dir", default="e2e-generated")
+    args = ap.parse_args()
+    os.makedirs(args.output_dir, exist_ok=True)
+    for name, _m, toml_text in generate(args.seed, args.count):
+        path = os.path.join(args.output_dir, f"{name}.toml")
+        with open(path, "w") as f:
+            f.write(toml_text)
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

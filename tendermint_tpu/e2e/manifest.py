@@ -20,6 +20,14 @@ TOML shape:
     perturb = ["kill"]          # kill | pause | restart | disconnect
     [node.validator0.misbehaviors]
     3 = "double-prevote"        # height -> misbehavior (maverick hooks)
+
+Perturbation semantics: kill/pause/restart match the reference's
+(test/e2e/runner/perturb.go:28-66). ``disconnect`` is an APPROXIMATION —
+subprocess nets have no network namespace to unplug, so it is a long
+SIGSTOP: peers drop the frozen node on ping timeout and re-dial after
+SIGCONT. One-way partitions and asymmetric connectivity are NOT
+representable; the reference uses docker network disconnect
+(perturb.go:48) for true partitions.
 """
 
 from __future__ import annotations

@@ -121,6 +121,8 @@ class Runner:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        # all subprocess nodes share the repo's warm XLA compile cache
+        env.setdefault("TMTPU_JAX_CACHE", os.path.join(REPO, ".jax_cache"))
         if nm.misbehaviors:
             env["TMTPU_MISBEHAVIORS"] = ",".join(
                 f"{h}:{b}" for h, b in sorted(nm.misbehaviors.items()))
@@ -143,7 +145,8 @@ class Runner:
         log = open(os.path.join(self.root, f"{nm.name}.log"), "a")
         self.procs[nm.name] = subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cmd",
-             "--home", cfg.root_dir, "start", "--log-level", "warning"],
+             "--home", cfg.root_dir, "start", "--log-level",
+             os.environ.get("TMTPU_E2E_LOG_LEVEL", "warning")],
             env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT)
 
     def start(self) -> None:

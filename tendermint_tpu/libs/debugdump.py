@@ -1,0 +1,107 @@
+"""Signal-triggered in-process diagnostic dump (VERDICT r4 #7).
+
+``cmd debug`` collects its bundle over RPC — useless against a node whose
+event loop is wedged, which is precisely when a dump matters. The reference
+always carries an out-of-band pprof listener (node/node.go:56,896) and
+``debug kill`` snapshots goroutine profiles before the SIGKILL
+(cmd/tendermint/commands/debug/kill.go). The analog here: a SIGUSR1 handler
+registered with ``signal.signal`` — NOT ``loop.add_signal_handler``, whose
+callbacks are loop callbacks and never run while the loop is stuck inside a
+callback — that synchronously writes:
+
+* every thread's current stack (``sys._current_frames``);
+* every asyncio task of the node's loop with its await stack;
+* the consensus round state repr and the open-peer table.
+
+The handler runs between Python bytecodes of whatever the main thread is
+executing, so a loop wedged in pure-Python spin still dumps; only a thread
+blocked inside a C call with the GIL held can suppress it (same limitation
+as Go's SIGQUIT dump for a wedged cgo call).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import signal
+import sys
+import time
+import traceback
+from typing import Optional
+
+_INSTALLED: dict = {}
+
+
+def write_dump(out_dir: str, node=None, loop=None) -> str:
+    """Write stacks + node state under out_dir; returns the dump path."""
+    os.makedirs(out_dir, exist_ok=True)
+
+    with open(os.path.join(out_dir, "threads.txt"), "w") as f:
+        for tid, frame in sys._current_frames().items():
+            f.write(f"--- thread {tid} ---\n")
+            f.write("".join(traceback.format_stack(frame)))
+            f.write("\n")
+
+    if loop is not None:
+        import asyncio
+
+        with open(os.path.join(out_dir, "tasks.txt"), "w") as f:
+            try:
+                tasks = asyncio.all_tasks(loop)
+            except Exception as e:
+                f.write(f"could not enumerate tasks: {e}\n")
+                tasks = []
+            for task in tasks:
+                f.write(f"--- {task!r} ---\n")
+                try:
+                    for frame in task.get_stack(limit=40):
+                        f.write("".join(traceback.format_stack(frame, limit=8)))
+                except Exception as e:
+                    f.write(f"  <stack unavailable: {e}>\n")
+                f.write("\n")
+
+    if node is not None:
+        with open(os.path.join(out_dir, "node_state.txt"), "w") as f:
+            try:
+                rs = node.consensus_state.rs
+                f.write(f"round_state: height={rs.height} round={rs.round} "
+                        f"step={rs.step}\n")
+            except Exception as e:
+                f.write(f"round_state unavailable: {e}\n")
+            try:
+                peers = node.switch.peers
+                f.write(f"peers ({len(peers)}):\n")
+                for pid, peer in list(peers.items()):
+                    f.write(f"  {pid} {getattr(peer, 'node_info', None)!r}\n")
+            except Exception as e:
+                f.write(f"peer table unavailable: {e}\n")
+            try:
+                f.write(f"blocks_synced: "
+                        f"{node.blockchain_reactor.blocks_synced}\n")
+            except Exception:
+                pass
+    return out_dir
+
+
+def install(home_dir: str, node=None, loop=None,
+            signum: int = signal.SIGUSR1) -> None:
+    """Register the dump handler; main thread only (CPython rule). Also arms
+    faulthandler on SIGABRT so hard crashes leave stacks too."""
+
+    def _handler(_sig, _frame):
+        out = os.path.join(home_dir, f"debug-{int(time.time())}")
+        try:
+            write_dump(out, node=node, loop=loop)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+
+    signal.signal(signum, _handler)
+    _INSTALLED[signum] = home_dir
+    try:
+        faulthandler.enable()
+    except Exception:
+        pass
+
+
+def installed_home(signum: int = signal.SIGUSR1) -> Optional[str]:
+    return _INSTALLED.get(signum)

@@ -1,0 +1,104 @@
+"""BaseService lifecycle (reference libs/service/service.go:24,97).
+
+The reference threads every long-lived component (reactors, pools, servers)
+through BaseService: Start/Stop are idempotent-with-error, OnStart/OnStop
+are the only overridable hooks, Quit exposes completion, Reset re-arms a
+stopped service. Components here historically hand-rolled `_started` flags;
+this is the shared abstraction, asyncio-flavored: ``wait()`` awaits the quit
+event instead of receiving on a channel.
+
+Adoption note: existing components keep their ad-hoc guards (each is tested
+through restart paths); new components should subclass this instead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+logger = logging.getLogger("tmtpu.service")
+
+
+class ServiceError(Exception):
+    pass
+
+
+class AlreadyStarted(ServiceError):
+    """(service.go ErrAlreadyStarted)"""
+
+
+class AlreadyStopped(ServiceError):
+    """(service.go ErrAlreadyStopped)"""
+
+
+class NotStarted(ServiceError):
+    """(service.go ErrNotStarted)"""
+
+
+class BaseService:
+    def __init__(self, name: str):
+        self.name = name
+        self._started = False
+        self._stopped = False
+        self._quit: Optional[asyncio.Event] = None
+
+    # -- lifecycle (service.go:139 Start, :171 Stop, :192 Reset) -----------
+
+    async def start(self) -> None:
+        if self._stopped:  # checked first: a stopped service stays "started"
+            raise AlreadyStopped(f"{self.name}: stopped, call reset() first")
+        if self._started:
+            raise AlreadyStarted(self.name)
+        self._started = True
+        self._quit = asyncio.Event()
+        logger.debug("starting %s", self.name)
+        try:
+            await self.on_start()
+        except Exception:
+            self._started = False
+            raise
+
+    async def stop(self) -> None:
+        if self._stopped:
+            raise AlreadyStopped(self.name)
+        if not self._started:
+            raise NotStarted(self.name)
+        self._stopped = True
+        logger.debug("stopping %s", self.name)
+        try:
+            await self.on_stop()
+        finally:
+            if self._quit is not None:
+                self._quit.set()
+
+    async def reset(self) -> None:
+        """Re-arm a STOPPED service (service.go:192: reset of a running
+        service is an error)."""
+        if not self._stopped:
+            raise ServiceError(f"{self.name}: can't reset a running service")
+        self._started = False
+        self._stopped = False
+        self._quit = None
+
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    async def wait(self) -> None:
+        """Block until the service stops (service.go Quit channel)."""
+        if self._quit is None:
+            raise NotStarted(self.name)
+        await self._quit.wait()
+
+    # -- hooks -------------------------------------------------------------
+
+    async def on_start(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    async def on_stop(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    def __str__(self) -> str:
+        state = ("running" if self.is_running()
+                 else "stopped" if self._stopped else "new")
+        return f"{self.name}({state})"

@@ -126,8 +126,8 @@ class LightProxy:
         app_hash = lb.signed_header.header.app_hash
         try:
             default_proof_runtime().verify_value(
-                ops, app_hash, key_path(resp_key := base64.b64decode(
-                    resp.get("key") or "") or data), value)
+                ops, app_hash, key_path(resp_key := (base64.b64decode(
+                    resp.get("key") or "") or data)), value)
         except ValueError as e:
             raise RPCError(-32603, f"query proof verification failed "
                                    f"for key {resp_key!r}: {e}")

@@ -207,12 +207,29 @@ class Node:
         self.indexer_service = None
         self.tx_indexer = None
         self.block_indexer = None
+        self.event_sink = None
         if config.tx_index.indexer == "kv":
             from .state.txindex import IndexerService, KVBlockIndexer, KVTxIndexer
 
             self.tx_indexer = KVTxIndexer(_make_db(backend, dbdir, "tx_index"))
             self.block_indexer = KVBlockIndexer(
                 _make_db(backend, dbdir, "block_index"))
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus)
+        elif config.tx_index.indexer == "psql":
+            # SQL event sink (reference state/indexer/sink/psql; sqlite
+            # engine here — see state/sink.py). Serves the same indexer
+            # seams so /tx and equality tx_search keep working.
+            import os as _os
+
+            from .state.sink import BlockSinkAdapter, SQLEventSink
+            from .state.txindex import IndexerService
+
+            conn = config.tx_index.psql_conn or _os.path.join(
+                dbdir, "events.sqlite")
+            self.event_sink = SQLEventSink(conn, genesis.chain_id)
+            self.tx_indexer = self.event_sink
+            self.block_indexer = BlockSinkAdapter(self.event_sink)
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus)
 
@@ -266,8 +283,13 @@ class Node:
             self.addr_book = None
             self.pex_reactor = None
 
+        from .p2p.trust import TrustMetricStore
+
+        self.trust_store = TrustMetricStore(
+            db=_make_db(backend, dbdir, "trust_history"))
         self.transport = TCPTransport(node_key, self.node_info, descs, mconn_cfg)
-        self.switch = Switch(node_key.id, transport=self.transport)
+        self.switch = Switch(node_key.id, transport=self.transport,
+                             trust_store=self.trust_store)
         for name, r in reactors.items():
             self.switch.add_reactor(name, r)
 

@@ -12,7 +12,7 @@ logger = logging.getLogger("tmtpu.p2p")
 
 
 class Switch:
-    def __init__(self, node_id: str, transport=None):
+    def __init__(self, node_id: str, transport=None, trust_store=None):
         self.node_id = node_id
         self.transport = transport  # TCPTransport or None (in-proc)
         self.reactors: Dict[str, Reactor] = {}
@@ -20,6 +20,10 @@ class Switch:
         self.peers: Dict[str, Peer] = {}
         self._running = False
         self._dial_tasks: Dict[str, asyncio.Task] = {}  # persistent redials
+        # optional p2p.trust.TrustMetricStore (reference p2p/trust/store.go):
+        # good/bad events feed EWMA scores; quarantined peers are refused on
+        # dial AND accept until their ban lapses
+        self.trust_store = trust_store
 
     # -- reactors (switch.go:163 AddReactor) -------------------------------
 
@@ -58,6 +62,8 @@ class Switch:
             await self.transport.close()
         for reactor in self.reactors.values():
             await reactor.stop()
+        if self.trust_store is not None:
+            self.trust_store.save()
 
     # -- TCP transport wiring (switch.go:665 acceptRoutine, :430 reconnect) --
 
@@ -71,6 +77,11 @@ class Switch:
         if not self._running or peer.id in self.peers or peer.id == self.node_id:
             await peer.stop()
             return
+        if self.trust_store is not None and self.trust_store.banned(peer.id):
+            logger.info("%s: refusing quarantined peer %s", self.node_id[:8],
+                        peer.id[:8])
+            await peer.stop()
+            return
         peer.bind(self)
         peer.start()
         await self.add_peer(peer)
@@ -80,6 +91,10 @@ class Switch:
         if self.transport is None:
             raise RuntimeError("switch has no transport")
         if addr.id in self.peers or addr.id == self.node_id:
+            return False
+        if self.trust_store is not None and self.trust_store.banned(addr.id):
+            logger.debug("%s: not dialing quarantined peer %s",
+                         self.node_id[:8], addr.id[:8])
             return False
         try:
             peer = await self.transport.dial(addr, persistent=persistent)
@@ -137,6 +152,8 @@ class Switch:
         self.peers[peer.id] = peer
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
+        if self.trust_store is not None:
+            self.trust_store.peer_good(peer.id)
         logger.debug("%s: added peer %s (%d total)", self.node_id[:8], peer.id[:8],
                      len(self.peers))
 
@@ -144,6 +161,8 @@ class Switch:
         """(switch.go:367)"""
         logger.info("%s: stopping peer %s for error: %s", self.node_id[:8],
                     peer.id[:8], reason)
+        if self.trust_store is not None:
+            self.trust_store.peer_bad(peer.id)
         await self._stop_and_remove_peer(peer, reason)
 
     async def stop_peer_gracefully(self, peer: Peer) -> None:

@@ -1,0 +1,201 @@
+"""Peer trust metrics (reference p2p/trust/metric.go:86, store.go).
+
+Each peer accumulates good/bad events; at interval boundaries the interval's
+proportion folds into a faded history. The trust value combines:
+
+* proportional component — this interval's good/(good+bad);
+* integral component — the history EWMA;
+* a derivative penalty when the trend is downward (the reference weights
+  negative derivatives so a recently-flapping peer scores below a stale
+  one, metric.go:258 calcTrustValue).
+
+Values live in [0, 1]. The store persists scores across restarts and the
+switch consults :meth:`TrustMetricStore.banned` before (re)dialing — a peer
+whose score sinks below the ban threshold is quarantined for
+``ban_duration`` seconds rather than forever (reference store keys peers by
+ID in a db-backed store, store.go:38).
+
+Design deltas from the reference, on purpose: time is injected (monotonic
+callable) so tests drive interval rollover deterministically, and the
+persistence format is a single JSON document per store rather than one
+leveldb row per peer — the peer counts here (dozens) don't justify a table.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, Optional
+
+# reference defaults (metric.go:17-24): proportional .4, integral .6,
+# 1-minute intervals over a (shortened) tracking window
+PROPORTIONAL_WEIGHT = 0.4
+INTEGRAL_WEIGHT = 0.6
+DEFAULT_INTERVAL = 60.0
+HISTORY_ALPHA = 0.2          # EWMA fade per interval
+DEFAULT_BAN_THRESHOLD = 0.25
+DEFAULT_BAN_DURATION = 600.0
+
+
+class TrustMetric:
+    def __init__(self, interval: float = DEFAULT_INTERVAL,
+                 now: Callable[[], float] = time.monotonic):
+        self._now = now
+        self.interval = interval
+        self.good = 0.0
+        self.bad = 0.0
+        self.history: Optional[float] = None  # EWMA of interval proportions
+        self.last_value = 1.0                 # previous interval's value
+        self._interval_start = now()
+
+    # -- events ------------------------------------------------------------
+
+    def record_good(self, n: float = 1.0) -> None:
+        self._maybe_roll()
+        self.good += n
+
+    def record_bad(self, n: float = 1.0) -> None:
+        self._maybe_roll()
+        self.bad += n
+
+    # -- value -------------------------------------------------------------
+
+    def value(self) -> float:
+        """Current trust in [0, 1] (metric.go:258 calcTrustValue)."""
+        self._maybe_roll()
+        hist = self.history
+        if self.good + self.bad == 0:
+            # no evidence THIS interval: score on history alone (a peer that
+            # went quiet right after flapping must not snap back to 1.0)
+            r = hist if hist is not None else 1.0
+        else:
+            r = self._proportion()
+        if hist is None:
+            hist = r
+        v = PROPORTIONAL_WEIGHT * r + INTEGRAL_WEIGHT * hist
+        d = v - self.last_value
+        if d < 0:
+            # negative trend weighted in, like the reference's derivative
+            # term: a peer getting worse scores below its averages
+            v += 0.5 * d
+        return max(0.0, min(1.0, v))
+
+    def _proportion(self) -> float:
+        total = self.good + self.bad
+        if total == 0:
+            return 1.0  # no evidence: neutral-good, like a fresh peer
+        return self.good / total
+
+    def _maybe_roll(self) -> None:
+        now = self._now()
+        while now - self._interval_start >= self.interval:
+            if self.good + self.bad > 0:  # empty intervals don't fade history
+                r = self._proportion()
+                self.history = (r if self.history is None
+                                else HISTORY_ALPHA * r
+                                + (1 - HISTORY_ALPHA) * self.history)
+                self.last_value = (PROPORTIONAL_WEIGHT * r
+                                   + INTEGRAL_WEIGHT * self.history)
+                self.good = self.bad = 0.0
+            self._interval_start += self.interval
+            if now - self._interval_start > 100 * self.interval:
+                # long-idle peer: skip ahead instead of looping for hours
+                self._interval_start = now
+                break
+
+    # -- persistence -------------------------------------------------------
+
+    def to_doc(self) -> dict:
+        self._maybe_roll()
+        return {"history": self.history, "last_value": self.last_value}
+
+    @classmethod
+    def from_doc(cls, doc: dict, interval: float = DEFAULT_INTERVAL,
+                 now: Callable[[], float] = time.monotonic) -> "TrustMetric":
+        m = cls(interval=interval, now=now)
+        m.history = doc.get("history")
+        m.last_value = float(doc.get("last_value", 1.0))
+        return m
+
+
+class TrustMetricStore:
+    """Per-peer metrics + ban decisions, persisted as one JSON doc
+    (reference p2p/trust/store.go:38 TrustMetricStore)."""
+
+    def __init__(self, db=None, key: bytes = b"p2p:trust",
+                 interval: float = DEFAULT_INTERVAL,
+                 ban_threshold: float = DEFAULT_BAN_THRESHOLD,
+                 ban_duration: float = DEFAULT_BAN_DURATION,
+                 now: Callable[[], float] = time.monotonic):
+        self._db = db
+        self._key = key
+        self._now = now
+        self._interval = interval
+        self.ban_threshold = ban_threshold
+        self.ban_duration = ban_duration
+        self.metrics: Dict[str, TrustMetric] = {}
+        self._bans: Dict[str, float] = {}  # peer id -> ban expiry (now() base)
+        self._load()
+
+    def get(self, peer_id: str) -> TrustMetric:
+        m = self.metrics.get(peer_id)
+        if m is None:
+            m = TrustMetric(interval=self._interval, now=self._now)
+            self.metrics[peer_id] = m
+        return m
+
+    # -- switch-facing API --------------------------------------------------
+
+    def peer_good(self, peer_id: str, n: float = 1.0) -> None:
+        self.get(peer_id).record_good(n)
+
+    def peer_bad(self, peer_id: str, n: float = 1.0) -> None:
+        m = self.get(peer_id)
+        m.record_bad(n)
+        if m.value() < self.ban_threshold:
+            self._bans[peer_id] = self._now() + self.ban_duration
+
+    def value(self, peer_id: str) -> float:
+        return self.get(peer_id).value()
+
+    def banned(self, peer_id: str) -> bool:
+        expiry = self._bans.get(peer_id)
+        if expiry is None:
+            return False
+        if self._now() >= expiry:
+            del self._bans[peer_id]
+            # parole: reset the metric so the peer isn't instantly re-banned
+            # by its own history (reference store re-creates on re-add)
+            self.metrics.pop(peer_id, None)
+            return False
+        return True
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self) -> None:
+        if self._db is None:
+            return
+        doc = {
+            "peers": {pid: m.to_doc() for pid, m in self.metrics.items()},
+            "bans": {pid: max(0.0, exp - self._now())
+                     for pid, exp in self._bans.items()},
+        }
+        self._db.set(self._key, json.dumps(doc).encode())
+
+    def _load(self) -> None:
+        if self._db is None:
+            return
+        raw = self._db.get(self._key)
+        if not raw:
+            return
+        try:
+            doc = json.loads(raw.decode())
+        except ValueError:
+            return
+        for pid, mdoc in doc.get("peers", {}).items():
+            self.metrics[pid] = TrustMetric.from_doc(
+                mdoc, interval=self._interval, now=self._now)
+        now = self._now()
+        for pid, remaining in doc.get("bans", {}).items():
+            if remaining > 0:
+                self._bans[pid] = now + float(remaining)

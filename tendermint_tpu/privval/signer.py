@@ -192,6 +192,16 @@ class SignerListenerEndpoint:
         self.host, self.port = self._listener.getsockname()[:2]
         self.conn_key = conn_key or Ed25519PrivKey.generate()
         self.expected_signer_key = expected_signer_key
+        if expected_signer_key is None:
+            # without a pinned key, whichever process dials first holds the
+            # signer slot and can stall consensus signing with well-formed
+            # errors — the handshake alone cannot tell the real signer apart
+            logger.warning(
+                "priv_validator_laddr listener on %s:%d has NO pinned signer "
+                "key: any dialer that completes the SecretConnection "
+                "handshake will be trusted as the signer; configure "
+                "priv_validator_signer_key for production", self.host,
+                self.port)
         self._conn: Optional[SyncSecretConnection] = None
         self._connected = threading.Event()
         self._lock = threading.Lock()

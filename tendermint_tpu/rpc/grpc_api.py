@@ -1,0 +1,155 @@
+"""gRPC BroadcastAPI (reference rpc/grpc/{api.go,types.pb.go}).
+
+Deprecated upstream in favor of the JSON-RPC interface but still served for
+wire parity: service ``tendermint.rpc.grpc.BroadcastAPI`` with
+
+* ``Ping(RequestPing) -> ResponsePing`` — both empty messages;
+* ``BroadcastTx(RequestBroadcastTx{tx bytes=1}) ->
+  ResponseBroadcastTx{check_tx=1, deliver_tx=2}`` — delegates to the
+  JSON-RPC environment's ``broadcast_tx_commit`` exactly like the
+  reference's broadcastAPI (api.go:29 calls core.BroadcastTxCommit).
+
+Bodies reuse the hand-rolled gogoproto-exact ABCI codec for the embedded
+ResponseCheckTx/ResponseDeliverTx messages; no generated stubs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..abci import types as abci
+from ..abci.proto_codec import _dec_response_body, _enc_response_body
+from ..libs import protowire as pw
+
+logger = logging.getLogger("tmtpu.rpc.grpc")
+
+SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+
+
+def _enc_request_broadcast_tx(tx: bytes) -> bytes:
+    w = pw.Writer()
+    w.bytes(1, tx)
+    return w.finish()
+
+
+def _dec_request_broadcast_tx(raw: bytes) -> bytes:
+    for fn, _wt, v in pw.iter_fields(raw):
+        if fn == 1:
+            return v
+    return b""
+
+
+def _result_to_abci(doc: dict, cls):
+    """JSON-RPC tx-result doc -> abci Response{Check,Deliver}Tx."""
+    return cls(
+        code=int(doc.get("code", 0)),
+        data=base64.b64decode(doc["data"]) if doc.get("data") else b"",
+        log=doc.get("log", ""),
+        gas_wanted=int(doc.get("gas_wanted", 0) or 0),
+        gas_used=int(doc.get("gas_used", 0) or 0),
+    )
+
+
+def _enc_response_broadcast_tx(check: abci.ResponseCheckTx,
+                               deliver: abci.ResponseDeliverTx) -> bytes:
+    w = pw.Writer()
+    w.message(1, _enc_response_body("check_tx", check))
+    w.message(2, _enc_response_body("deliver_tx", deliver))
+    return w.finish()
+
+
+def _dec_response_broadcast_tx(raw: bytes):
+    check = deliver = None
+    for fn, _wt, v in pw.iter_fields(raw):
+        if fn == 1:
+            check = _dec_response_body("check_tx", v)
+        elif fn == 2:
+            deliver = _dec_response_body("deliver_tx", v)
+    return check, deliver
+
+
+class BroadcastAPIServer:
+    """Serves BroadcastAPI next to the JSON-RPC server; calls into the same
+    Environment on the node's asyncio loop (the gRPC worker threads bridge
+    with run_coroutine_threadsafe)."""
+
+    def __init__(self, addr: str, env, loop: asyncio.AbstractEventLoop,
+                 max_workers: int = 2):
+        self._env = env
+        self._loop = loop
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.port = self._server.add_insecure_port(addr)
+
+    def _handler(self) -> grpc.GenericRpcHandler:
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                name = handler_call_details.method.rsplit("/", 1)[-1]
+                if not handler_call_details.method.startswith(f"/{SERVICE}/"):
+                    return None
+                if name == "Ping":
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: b"",
+                        request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b)
+                if name == "BroadcastTx":
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._broadcast_tx,
+                        request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b)
+                return None
+
+        return Handler()
+
+    def _broadcast_tx(self, req_bytes: bytes, context) -> bytes:
+        tx = _dec_request_broadcast_tx(req_bytes)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._env.broadcast_tx_commit(base64.b64encode(tx).decode()),
+            self._loop)
+        try:
+            doc = fut.result(timeout=60.0)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return b""
+        check = _result_to_abci(doc.get("check_tx", {}), abci.ResponseCheckTx)
+        deliver = _result_to_abci(doc.get("deliver_tx", {}),
+                                  abci.ResponseDeliverTx)
+        return _enc_response_broadcast_tx(check, deliver)
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("gRPC BroadcastAPI on port %d", self.port)
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class BroadcastAPIClient:
+    def __init__(self, addr: str, timeout: float = 60.0):
+        self._chan = grpc.insecure_channel(addr)
+        self._timeout = timeout
+
+    def ping(self) -> None:
+        fn = self._chan.unary_unary(f"/{SERVICE}/Ping",
+                                    request_serializer=lambda b: b,
+                                    response_deserializer=lambda b: b)
+        fn(b"", timeout=self._timeout)
+
+    def broadcast_tx(self, tx: bytes):
+        fn = self._chan.unary_unary(f"/{SERVICE}/BroadcastTx",
+                                    request_serializer=lambda b: b,
+                                    response_deserializer=lambda b: b)
+        raw = fn(_enc_request_broadcast_tx(tx), timeout=self._timeout)
+        return _dec_response_broadcast_tx(raw)
+
+    def close(self) -> None:
+        self._chan.close()

@@ -1,0 +1,263 @@
+"""SQL event sink — the second indexer backend
+(reference state/indexer/sink/psql/{psql.go,schema.sql}).
+
+Schema parity with the reference's psql sink: ``blocks``, ``tx_results``,
+``events``, ``attributes`` plus the ``event_attributes`` / ``block_events``
+/ ``tx_events`` views, so operator queries written against the reference's
+schema run unchanged. The storage engine is stdlib ``sqlite3`` — this image
+carries no Postgres server or driver — with the DDL kept in the psql
+dialect's shape (AUTOINCREMENT keys standing in for BIGSERIAL, TEXT for
+TIMESTAMPTZ, BLOB for BYTEA); a psycopg2 connection could execute the
+reference's schema.sql verbatim and reuse this class's DML unchanged modulo
+the ``?`` placeholder style.
+
+Like the reference sink it is write-mostly: queries go through
+``get_tx_by_hash`` / ``has_block`` / ``search_tx_events`` (equality
+conditions over composite keys, psql.go:239).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .txindex import TxResult
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  height     BIGINT NOT NULL,
+  chain_id   VARCHAR NOT NULL,
+  created_at TEXT NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE INDEX IF NOT EXISTS idx_blocks_height_chain ON blocks(height, chain_id);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+  "index"    INTEGER NOT NULL,
+  created_at TEXT NOT NULL,
+  tx_hash    VARCHAR NOT NULL,
+  tx_result  BLOB NOT NULL,
+  UNIQUE (block_id, "index")
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_id    BIGINT NULL REFERENCES tx_results(rowid),
+  type     VARCHAR NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      BIGINT NOT NULL REFERENCES events(rowid),
+  key           VARCHAR NOT NULL,
+  composite_key VARCHAR NOT NULL,
+  value         VARCHAR NULL,
+  UNIQUE (event_id, key)
+);
+CREATE VIEW IF NOT EXISTS event_attributes AS
+  SELECT block_id, tx_id, type, key, composite_key, value
+  FROM events LEFT JOIN attributes ON (events.rowid = attributes.event_id);
+CREATE VIEW IF NOT EXISTS block_events AS
+  SELECT blocks.rowid as block_id, height, chain_id, type, key,
+         composite_key, value
+  FROM blocks JOIN event_attributes ON
+       (blocks.rowid = event_attributes.block_id)
+  WHERE event_attributes.tx_id IS NULL;
+CREATE VIEW IF NOT EXISTS tx_events AS
+  SELECT height, "index", chain_id, type, key, composite_key, value,
+         tx_results.created_at
+  FROM blocks JOIN tx_results ON (blocks.rowid = tx_results.block_id)
+  JOIN event_attributes ON (tx_results.rowid = event_attributes.tx_id)
+  WHERE event_attributes.tx_id IS NOT NULL;
+"""
+
+
+def _split_composite(key: str) -> str:
+    """'transfer.amount' -> bare key 'amount' (psql.go stores both)."""
+    return key.rsplit(".", 1)[-1]
+
+
+def _cond_str(value) -> str:
+    """Query condition value -> the string form events store ('5', not
+    '5.0'; quotes stripped)."""
+    if isinstance(value, float) and value.is_integer():
+        return str(int(value))
+    return str(value).strip("'")
+
+
+class SQLEventSink:
+    """psql.go EventSink. connect string ":memory:" or a file path."""
+
+    def __init__(self, conn_str: str, chain_id: str):
+        self.chain_id = chain_id
+        # the indexer pump runs on the event-bus loop; RPC queries come from
+        # request handlers — one connection guarded by a lock keeps sqlite
+        # happy in both
+        self._conn = sqlite3.connect(conn_str, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock, self._conn:
+            self._conn.executescript(_SCHEMA)
+
+    # -- write path (psql.go:142,177) --------------------------------------
+
+    def index_block_events(self, height: int,
+                           events: Dict[str, List[str]]) -> None:
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "INSERT INTO blocks (height, chain_id, created_at) "
+                "VALUES (?, ?, ?) "
+                "ON CONFLICT (height, chain_id) DO UPDATE SET created_at = ?",
+                (height, self.chain_id, now, now))
+            block_rowid = self._block_rowid(height)
+            self._insert_events(block_rowid, None, events)
+
+    def index_tx_events(self, results: List[TxResult]) -> None:
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with self._lock, self._conn:
+            for r in results:
+                self._conn.execute(
+                    "INSERT OR IGNORE INTO blocks (height, chain_id, "
+                    "created_at) VALUES (?, ?, ?)",
+                    (r.height, self.chain_id, now))
+                block_rowid = self._block_rowid(r.height)
+                tx_hash = hashlib.sha256(r.tx).hexdigest().upper()
+                cur = self._conn.execute(
+                    'INSERT INTO tx_results (block_id, "index", created_at, '
+                    "tx_hash, tx_result) VALUES (?, ?, ?, ?, ?) "
+                    'ON CONFLICT (block_id, "index") DO UPDATE SET '
+                    "tx_result = excluded.tx_result",
+                    (block_rowid, r.index, now, tx_hash, r.to_json()))
+                tx_rowid = self._conn.execute(
+                    'SELECT rowid FROM tx_results WHERE block_id=? AND '
+                    '"index"=?', (block_rowid, r.index)).fetchone()[0]
+                # implicit tx.height, like the kv indexer (kv.go indexes it
+                # for every tx so height queries always work)
+                events = dict(r.events)
+                events.setdefault("tx.height", [str(r.height)])
+                self._insert_events(block_rowid, tx_rowid, events)
+
+    def _block_rowid(self, height: int) -> int:
+        return self._conn.execute(
+            "SELECT rowid FROM blocks WHERE height=? AND chain_id=?",
+            (height, self.chain_id)).fetchone()[0]
+
+    def _insert_events(self, block_id: int, tx_id: Optional[int],
+                       events: Dict[str, List[str]]) -> None:
+        # events arrive flattened as composite_key -> values (the event-bus
+        # form); regroup by event type for the events table
+        by_type: Dict[str, List] = {}
+        for ckey, values in events.items():
+            etype = ckey.rsplit(".", 1)[0] if "." in ckey else ckey
+            for v in values:
+                by_type.setdefault(etype, []).append((ckey, v))
+        for etype, attrs in by_type.items():
+            cur = self._conn.execute(
+                "INSERT INTO events (block_id, tx_id, type) VALUES (?, ?, ?)",
+                (block_id, tx_id, etype))
+            event_id = cur.lastrowid
+            for ckey, v in attrs:
+                self._conn.execute(
+                    "INSERT OR IGNORE INTO attributes (event_id, key, "
+                    "composite_key, value) VALUES (?, ?, ?, ?)",
+                    (event_id, _split_composite(ckey), ckey, v))
+
+    # -- read path (psql.go:244,249,239) ------------------------------------
+
+    def get_tx_by_hash(self, tx_hash: bytes) -> Optional[TxResult]:
+        hx = tx_hash.hex().upper()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT tx_result FROM tx_results WHERE tx_hash=? "
+                "ORDER BY rowid DESC LIMIT 1", (hx,)).fetchone()
+        return TxResult.from_json(row[0]) if row else None
+
+    def has_block(self, height: int) -> bool:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT 1 FROM blocks WHERE height=? AND chain_id=?",
+                (height, self.chain_id)).fetchone()
+        return row is not None
+
+    def search_tx_events(self, composite_key: str, value: str,
+                         limit: int = 100) -> List[TxResult]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT tx_results.tx_result FROM tx_results "
+                "JOIN events ON events.tx_id = tx_results.rowid "
+                "JOIN attributes ON attributes.event_id = events.rowid "
+                "WHERE attributes.composite_key=? AND attributes.value=? "
+                "ORDER BY tx_results.rowid LIMIT ?",
+                (composite_key, value, limit)).fetchall()
+        return [TxResult.from_json(r[0]) for r in rows]
+
+    def search_block_events(self, composite_key: str, value: str,
+                            limit: int = 100) -> List[int]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT height FROM block_events "
+                "WHERE composite_key=? AND value=? ORDER BY height LIMIT ?",
+                (composite_key, value, limit)).fetchall()
+        return [r[0] for r in rows]
+
+    def stop(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- txindex-compatible seams (so the sink can serve IndexerService and
+    # the /tx + tx_search RPC routes when configured as THE indexer; the
+    # reference's psql sink rejects searches, psql.go:234 — equality-only
+    # search is supported here because sqlite makes it free) ----------------
+
+    def index(self, result: TxResult) -> None:
+        self.index_tx_events([result])
+
+    def get(self, tx_hash: bytes) -> Optional[TxResult]:
+        return self.get_tx_by_hash(tx_hash)
+
+    def search(self, query: str, limit: int = 100) -> List[TxResult]:
+        from .txindex import Query
+
+        q = Query(query)
+        by_key: dict = {}
+        result_sets = []
+        for cond in q.conditions:
+            if cond.op != "=":
+                raise ValueError(
+                    "SQL event sink supports equality conditions only")
+            hits = self.search_tx_events(cond.key, _cond_str(cond.value),
+                                         limit=10_000)
+            by_key.update({(r.height, r.index): r for r in hits})
+            result_sets.append({(r.height, r.index) for r in hits})
+        if not result_sets:
+            return []
+        keys = sorted(set.intersection(*result_sets))
+        return [by_key[k] for k in keys[:limit]]
+
+
+class BlockSinkAdapter:
+    """KVBlockIndexer-shaped facade over the sink (IndexerService seam)."""
+
+    def __init__(self, sink: SQLEventSink):
+        self._sink = sink
+
+    def index(self, height: int, events: Dict[str, List[str]]) -> None:
+        self._sink.index_block_events(height, events)
+
+    def search(self, query: str, limit: int = 100) -> List[int]:
+        from .txindex import Query
+
+        q = Query(query)
+        sets = []
+        for cond in q.conditions:
+            if cond.op != "=":
+                raise ValueError(
+                    "SQL event sink supports equality conditions only")
+            sets.append(set(self._sink.search_block_events(
+                cond.key, _cond_str(cond.value), limit=10_000)))
+        if not sets:
+            return []
+        return sorted(set.intersection(*sets))[:limit]

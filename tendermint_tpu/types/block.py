@@ -15,7 +15,7 @@ from .. import crypto
 from ..crypto import merkle
 from ..libs import protowire as pw
 from .basic import BlockID, BlockIDFlag, PartSetHeader, SignedMsgType, ZERO_TIME_NS
-from .canonical import vote_sign_bytes
+from .canonical import vote_sign_bytes, vote_sign_bytes_batch
 from .tx import txs_hash
 from .vote import MAX_SIGNATURE_SIZE, Vote
 
@@ -295,6 +295,26 @@ class Commit:
             cs.timestamp_ns,
         )
 
+    def vote_sign_bytes_all(self, chain_id: str) -> List[bytes]:
+        """Every validator's canonical sign-bytes in one pass, memoized per
+        chain_id. Batched commit verification needs all rows anyway, and the
+        shared-field assembly (canonical.vote_sign_bytes_batch) plus the memo
+        cut the dominant host-side cost of the device verify path. Commits
+        are immutable once built, so the memo never invalidates."""
+        cache = self.__dict__.setdefault("_sb_cache", {})
+        hit = cache.get(chain_id)
+        if hit is None:
+            hit = vote_sign_bytes_batch(
+                chain_id,
+                SignedMsgType.PRECOMMIT,
+                self.height,
+                self.round,
+                [cs.block_id(self.block_id) for cs in self.signatures],
+                [cs.timestamp_ns for cs in self.signatures],
+            )
+            cache[chain_id] = hit
+        return hit
+
     def size(self) -> int:
         return len(self.signatures)
 
@@ -412,10 +432,20 @@ class Block:
             raise ValueError("wrong Header.EvidenceHash")
 
     def make_part_set(self, part_size: int = 65536):
+        """Memoized: the sync/consensus paths build the part set of the same
+        block several times (gossip entries, store save, proposal); encoding
+        a 1000-signature block costs tens of ms, so rebuild only when asked
+        for a different part size. Blocks are frozen once assembled (the
+        memo key includes nothing mutable: fill_header() is idempotent)."""
+        cached = self.__dict__.get("_part_set_cache")
+        if cached is not None and cached[0] == part_size:
+            return cached[1]
         from .part_set import PartSet
 
         self.fill_header()
-        return PartSet.from_data(self.encode(), part_size)
+        ps = PartSet.from_data(self.encode(), part_size)
+        self.__dict__["_part_set_cache"] = (part_size, ps)
+        return ps
 
     # -- proto (types/block.proto Block) ----------------------------------
 

@@ -95,14 +95,31 @@ class ValidatorSet:
         vs._total_voting_power = self._total_voting_power
         return vs
 
+    def _addr_index(self) -> dict:
+        """address -> index, rebuilt whenever the validators list object is
+        replaced or resized (every structural mutation reassigns the list;
+        priority updates mutate Validator objects but never addresses or
+        order, so the cache stays valid across IncrementProposerPriority).
+        At light-client/commit-verification scale the linear scan was the
+        single hottest host-side cost (1000-validator sets x 32k lookups)."""
+        cache = self.__dict__.get("_addr_cache")
+        if (cache is None or cache[0] is not self.validators
+                or len(cache[1]) != len(self.validators)):
+            idx: dict = {}
+            for i, v in enumerate(self.validators):
+                idx.setdefault(v.address, i)  # first match wins, like the scan
+            cache = (self.validators, idx)
+            self.__dict__["_addr_cache"] = cache
+        return cache[1]
+
     def has_address(self, address: bytes) -> bool:
-        return any(v.address == address for v in self.validators)
+        return address in self._addr_index()
 
     def get_by_address(self, address: bytes) -> Tuple[int, Optional[Validator]]:
-        for i, v in enumerate(self.validators):
-            if v.address == address:
-                return i, v.copy()
-        return -1, None
+        i = self._addr_index().get(address)
+        if i is None:
+            return -1, None
+        return i, self.validators[i].copy()
 
     def get_by_index(self, index: int) -> Tuple[bytes, Optional[Validator]]:
         if index < 0 or index >= len(self.validators):
@@ -386,9 +403,14 @@ class ValidatorSet:
         if not idxs:
             return []
         bv = BatchVerifier()
+        # amortized sign-bytes: one shared-field encode for the whole commit
+        # instead of len(idxs) canonical encodes (the host-side cost floor)
+        sb = (commit.vote_sign_bytes_all(chain_id) if len(idxs) > 32
+              else None)
         for pos, idx in enumerate(idxs):
             pk = pubkeys[pos] if pubkeys is not None else self.validators[idx].pub_key
-            bv.add(pk, commit.vote_sign_bytes(chain_id, idx), commit.signatures[idx].signature)
+            msg = sb[idx] if sb is not None else commit.vote_sign_bytes(chain_id, idx)
+            bv.add(pk, msg, commit.signatures[idx].signature)
         _, per_item = bv.verify()
         return [bool(b) for b in per_item]
 
@@ -447,10 +469,10 @@ def verify_commit_light_batched(
             continue
         shape_errors.append(None)
         idxs = [i for i, cs in enumerate(commit.signatures) if cs.for_block()]
+        sb = commit.vote_sign_bytes_all(chain_id)
+        vals = val_set.validators
         for idx in idxs:
-            bv.add(val_set.validators[idx].pub_key,
-                   commit.vote_sign_bytes(chain_id, idx),
-                   commit.signatures[idx].signature)
+            bv.add(vals[idx].pub_key, sb[idx], commit.signatures[idx].signature)
         slices.append((off, idxs))
         off += len(idxs)
     _, per_item = bv.verify()
@@ -469,6 +491,87 @@ def verify_commit_light_batched(
                 err = ErrWrongSignature(idx, commit.signatures[idx].signature)
                 break
             tallied += val_set.validators[idx].voting_power
+            if tallied > needed:
+                break
+        else:
+            err = ErrNotEnoughVotingPowerSigned(tallied, needed)
+        results.append(err)
+    return results
+
+
+def verify_commit_light_trusting_batched(
+    entries: Sequence[Tuple["ValidatorSet", str, object, "Fraction"]],
+) -> List[Optional[Exception]]:
+    """Window-batched VerifyCommitLightTrusting: the light client's bisection
+    walk verifies a chain of headers against a *trusted* set
+    (validator_set.go:775, light/verifier.go:32) — all candidate signatures
+    across the window ride one batched device call, then each commit's
+    scalar precedence loop (address lookup, duplicate-vote check, trust-level
+    tally with early exit) replays over its verdict slice.
+
+    Entries: (trusted_val_set, chain_id, commit, trust_level).
+    Per-entry outcome is None (ok) or the exact exception
+    verify_commit_light_trusting would have raised.
+    """
+    bv = BatchVerifier()
+    slices: List[Tuple[int, List[Tuple[int, int, Validator]]]] = []
+    pre_errors: List[Optional[Exception]] = []
+    needed_list: List[int] = []
+    off = 0
+    for val_set, chain_id, commit, trust_level in entries:
+        numer, denom = trust_level
+        if denom == 0:
+            pre_errors.append(ValueError("trustLevel has zero Denominator"))
+            slices.append((off, []))
+            needed_list.append(0)
+            continue
+        total_mul, overflow = safe_mul(val_set.total_voting_power(), numer)
+        if overflow:
+            pre_errors.append(OverflowError(
+                "int64 overflow while calculating voting power needed. "
+                "please provide smaller trustLevel numerator"
+            ))
+            slices.append((off, []))
+            needed_list.append(0)
+            continue
+        pre_errors.append(None)
+        needed_list.append(total_mul // denom)
+        sb = commit.vote_sign_bytes_all(chain_id)
+        addr_idx = val_set._addr_index()
+        vals = val_set.validators
+        cand: List[Tuple[int, int, Validator]] = []
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            val_idx = addr_idx.get(cs.validator_address)
+            if val_idx is not None:
+                val = vals[val_idx]
+                cand.append((idx, val_idx, val))
+                bv.add(val.pub_key, sb[idx], cs.signature)
+        slices.append((off, cand))
+        off += len(cand)
+    _, per_item = bv.verify()
+
+    results: List[Optional[Exception]] = []
+    for entry, pre_err, (start, cand), needed in zip(
+            entries, pre_errors, slices, needed_list):
+        if pre_err is not None:
+            results.append(pre_err)
+            continue
+        _vs, _chain, commit, _tl = entry
+        tallied = 0
+        seen: dict = {}
+        err: Optional[Exception] = None
+        for pos, (idx, val_idx, val) in enumerate(cand):
+            if val_idx in seen:
+                err = ValueError(
+                    f"double vote from {val}: ({seen[val_idx]} and {idx})")
+                break
+            seen[val_idx] = idx
+            if not per_item[start + pos]:
+                err = ErrWrongSignature(idx, commit.signatures[idx].signature)
+                break
+            tallied += val.voting_power
             if tallied > needed:
                 break
         else:

@@ -290,3 +290,55 @@ def test_fast_sync_200_blocks_then_join_consensus(one_val_genesis, monkeypatch):
             await src.stop()
 
     asyncio.run(run())
+
+
+def test_window_precompute_covers_both_planes(one_val_genesis, monkeypatch):
+    """The dual-plane window precompute (light seen-commit + LastCommit full
+    VerifyCommit) must actually engage and feed apply_block's verify_commit
+    through precomputed verdicts — one batched scope per window instead of
+    a dispatch per block."""
+    import tendermint_tpu.blockchain.reactor as R
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.state import StateStore, state_from_genesis
+    from tendermint_tpu.store import BlockStore
+
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    monkeypatch.setattr(R, "PRECOMPUTE_MIN_SIGS", 2)
+    pv, genesis = one_val_genesis
+    _state, _ss, src_store, conns, _app = build_chain(12, pv, genesis)
+
+    # fresh replaying node
+    app2 = KVStoreApplication()
+    conns2 = AppConns(local_client_creator(app2))
+    conns2.start()
+    state2 = state_from_genesis(genesis)
+    ss2 = StateStore(MemDB())
+    ss2.save(state2)
+    bs2 = BlockStore(MemDB())
+    ex2 = BlockExecutor(ss2, conns2.consensus, NoOpMempool(),
+                        EmptyEvidencePool(), bs2)
+    reactor = R.BlockchainReactor(state2, ex2, bs2, fast_sync=True)
+    reactor.pool = R.BlockPool(1)
+    reactor.pool.set_peer_range("src", 1, 12)
+
+    before = dict(crypto_batch.stats)
+
+    async def drive():
+        while reactor.blocks_synced < 10:
+            for pid, h in reactor.pool.schedule_requests():
+                reactor.pool.add_block(pid, src_store.load_block(h))
+            applied = reactor.blocks_synced
+            await reactor._process_window()
+            if reactor.blocks_synced == applied:
+                break
+
+    asyncio.run(drive())
+    assert reactor.blocks_synced >= 10
+    pre_sigs = crypto_batch.stats["precomputed_sigs"] - before.get(
+        "precomputed_sigs", 0)
+    # both planes consumed precomputed verdicts: the light batched call AND
+    # apply_block's per-block full verify_commit
+    assert pre_sigs > 0, dict(crypto_batch.stats)
+    conns.stop()
+    conns2.stop()

@@ -211,7 +211,11 @@ def test_vote_path_takes_device_batches():
            for i in range(n)]
     val_set = ValidatorSet([Validator(pv.get_pub_key().address(), pv.get_pub_key(), 10)
                             for pv in pvs])
-    verifier = BatchVoteVerifier(min_device_batch=2, deadline_s=0.02)
+    # device_timeout_s far above first-call tracing time: this test asserts
+    # ROUTING (the flush must ride the device), not the liveness fallback —
+    # that is covered by test_vote_batcher_liveness.py
+    verifier = BatchVoteVerifier(min_device_batch=2, deadline_s=0.02,
+                                 device_timeout_s=600.0)
     vote_set = VoteSet(CHAIN_ID, 5, 0, SignedMsgType.PRECOMMIT, val_set,
                        verifier=verifier)
     bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))

@@ -1,0 +1,91 @@
+"""SIGUSR1 in-process dump for wedged nodes (reference keeps a pprof
+listener for this, node/node.go:896; debug/kill.go snapshots goroutines).
+
+The key property: the dump must work when the asyncio loop CANNOT serve a
+callback — so the wedge here is a loop thread stuck in a pure-Python spin
+inside a loop callback, and the dump still captures its stack.
+"""
+
+import asyncio
+import os
+import signal
+import threading
+import time
+
+from tendermint_tpu.libs import debugdump
+
+
+def test_dump_captures_wedged_loop(tmp_path):
+    loop = asyncio.new_event_loop()
+    wedged = threading.Event()
+    release = threading.Event()
+
+    async def innocent_task():
+        await asyncio.sleep(300)  # parked task: must appear in tasks.txt
+
+    def wedge_forever():
+        # a loop callback that never returns: the loop cannot process
+        # anything else (loop.add_signal_handler would never fire)
+        wedged.set()
+        while not release.is_set():
+            time.sleep(0.01)
+
+    def run_loop():
+        asyncio.set_event_loop(loop)
+        loop.create_task(innocent_task(), name="innocent-sleeper")
+        loop.call_soon(wedge_forever)
+        loop.run_forever()
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    assert wedged.wait(5), "loop thread failed to wedge"
+
+    out = debugdump.write_dump(str(tmp_path / "dump"), loop=loop)
+
+    threads = open(os.path.join(out, "threads.txt")).read()
+    assert "wedge_forever" in threads, "wedged callback stack missing"
+    tasks = open(os.path.join(out, "tasks.txt")).read()
+    assert "innocent-sleeper" in tasks or "innocent_task" in tasks
+
+    release.set()
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+    loop.close()
+
+
+def test_signal_handler_writes_bundle(tmp_path):
+    home = str(tmp_path / "home")
+    os.makedirs(home)
+    debugdump.install(home)
+    assert debugdump.installed_home() == home
+    os.kill(os.getpid(), signal.SIGUSR1)
+    # synchronous handler: the bundle exists by the time kill() returns
+    deadline = time.time() + 5
+    dumps = []
+    while time.time() < deadline and not dumps:
+        dumps = [d for d in os.listdir(home) if d.startswith("debug-")]
+        time.sleep(0.05)
+    assert dumps, "no dump directory created"
+    threads = open(os.path.join(home, dumps[0], "threads.txt")).read()
+    assert "test_signal_handler_writes_bundle" in threads
+    signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+def test_dump_includes_node_state(tmp_path):
+    class _RS:
+        height, round, step = 7, 1, "prevote"
+
+    class _CS:
+        rs = _RS()
+
+    class _Switch:
+        peers = {"ab12": object()}
+
+    class _Node:
+        consensus_state = _CS()
+        switch = _Switch()
+
+    out = debugdump.write_dump(str(tmp_path / "dump"), node=_Node())
+    state = open(os.path.join(out, "node_state.txt")).read()
+    assert "height=7" in state and "prevote" in state
+    assert "ab12" in state

@@ -1,0 +1,141 @@
+"""Window-batched light-client verification + batched sign-bytes encoder.
+
+verify_commit_light_trusting_batched must replay the exact semantics of the
+sequential verify_commit_light_trusting (reference validator_set.go:775),
+and canonical.vote_sign_bytes_batch must be byte-identical to the per-index
+encoder — it is the host-side cost floor of the batched device path.
+"""
+
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from tendermint_tpu import crypto
+from tendermint_tpu.types import Validator, ValidatorSet
+from tendermint_tpu.types.basic import (
+    BlockID,
+    BlockIDFlag,
+    PartSetHeader,
+    SignedMsgType,
+)
+from tendermint_tpu.types.block import Commit, CommitSig
+from tendermint_tpu.types.canonical import vote_sign_bytes, vote_sign_bytes_batch
+from tendermint_tpu.types.validator_set import (
+    ErrNotEnoughVotingPowerSigned,
+    ErrWrongSignature,
+    verify_commit_light_trusting_batched,
+)
+
+CHAIN = "light-batched-test"
+
+
+def _mk_val_set(n, seed=7):
+    rng = np.random.default_rng(seed)
+    keys, vals = {}, []
+    for _ in range(n):
+        sk = Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+        pub = crypto.Ed25519PubKey(sk.public_key().public_bytes_raw())
+        keys[pub.address()] = sk
+        vals.append(Validator(pub.address(), pub, 10))
+    return ValidatorSet(vals), keys
+
+
+def _sign_commit(vs, keys, height, nil_every=0):
+    bid = BlockID(height.to_bytes(8, "big") * 4, PartSetHeader(1, b"\x02" * 32))
+    sigs = []
+    for i, v in enumerate(vs.validators):
+        ts = 1_700_000_000_000_000_000 + height * 1_000_000 + i
+        flag = (BlockIDFlag.NIL if nil_every and i % nil_every == 0
+                else BlockIDFlag.COMMIT)
+        cs_bid = bid if flag == BlockIDFlag.COMMIT else BlockID()
+        from tendermint_tpu.types.canonical import vote_sign_bytes as vsb
+
+        msg = vsb(CHAIN, SignedMsgType.PRECOMMIT, height, 0, cs_bid, ts)
+        sigs.append(CommitSig(flag, v.address, ts, keys[v.address].sign(msg)))
+    return Commit(height, 0, bid, sigs), bid
+
+
+def test_vote_sign_bytes_batch_matches_per_index():
+    bid = BlockID(b"\x11" * 32, PartSetHeader(3, b"\x22" * 32))
+    zero = BlockID()
+    rows = [
+        (bid, 1_700_000_000_123_456_789),
+        (zero, 0),                       # zero time: Timestamp still emitted
+        (bid, 1),                        # 1ns: nanos varint only
+        (zero, 2_000_000_000_000_000_000),
+        (bid, 999_999_999),              # sub-second boundary
+    ]
+    got = vote_sign_bytes_batch(CHAIN, SignedMsgType.PRECOMMIT, 77, 2,
+                                [r[0] for r in rows], [r[1] for r in rows])
+    want = [vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 77, 2, b, t)
+            for b, t in rows]
+    assert got == want
+
+
+def test_commit_vote_sign_bytes_all_matches_and_memoizes(monkeypatch):
+    vs, keys = _mk_val_set(12)
+    commit, _bid = _sign_commit(vs, keys, 9, nil_every=5)
+    all_sb = commit.vote_sign_bytes_all(CHAIN)
+    assert all_sb == [commit.vote_sign_bytes(CHAIN, i)
+                      for i in range(len(vs.validators))]
+    assert commit.vote_sign_bytes_all(CHAIN) is all_sb  # memo hit
+    assert commit.vote_sign_bytes_all("other") != all_sb  # keyed by chain
+
+
+def test_trusting_batched_matches_sequential(monkeypatch):
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    vs, keys = _mk_val_set(20)
+    trust = (1, 3)
+    commits = [_sign_commit(vs, keys, h)[0] for h in range(2, 7)]
+
+    # happy path: every entry None, sequential agrees
+    entries = [(vs, CHAIN, c, trust) for c in commits]
+    assert all(e is None for e in verify_commit_light_trusting_batched(entries))
+    for c in commits:
+        vs.verify_commit_light_trusting(CHAIN, c, trust)
+
+    # corrupt an EARLY-POSITION signature of commit 2: that entry errors,
+    # neighbors unaffected
+    bad = commits[2]
+    sig = bytearray(bad.signatures[0].signature)
+    sig[0] ^= 1
+    bad.signatures[0].signature = bytes(sig)
+    results = verify_commit_light_trusting_batched(entries)
+    assert isinstance(results[2], ErrWrongSignature)
+    assert all(r is None for i, r in enumerate(results) if i != 2)
+    with pytest.raises(ErrWrongSignature):
+        vs.verify_commit_light_trusting(CHAIN, bad, trust)
+
+    # a LATE corrupt signature past the trust-level early exit is never
+    # examined — batched must preserve the early-exit semantics
+    late = commits[3]
+    sig = bytearray(late.signatures[-1].signature)
+    sig[0] ^= 1
+    late.signatures[-1].signature = bytes(sig)
+    results = verify_commit_light_trusting_batched(entries)
+    assert results[3] is None
+    vs.verify_commit_light_trusting(CHAIN, late, trust)
+
+
+def test_trusting_batched_insufficient_power_and_zero_denominator():
+    vs, keys = _mk_val_set(9)
+    commit, _ = _sign_commit(vs, keys, 3)
+    # strip most signatures to absent: not enough power for 2/3 trust
+    for i in range(1, 9):
+        commit.signatures[i] = CommitSig.new_absent()
+    results = verify_commit_light_trusting_batched(
+        [(vs, CHAIN, commit, (2, 3)), (vs, CHAIN, commit, (1, 0))])
+    assert isinstance(results[0], ErrNotEnoughVotingPowerSigned)
+    assert isinstance(results[1], ValueError)
+
+
+def test_trusting_batched_foreign_addresses_skipped():
+    """Signatures from validators outside the trusted set don't count
+    (the light client's core trust rule)."""
+    vs, keys = _mk_val_set(8)
+    other_vs, other_keys = _mk_val_set(8, seed=99)
+    commit, _ = _sign_commit(other_vs, other_keys, 4)
+    results = verify_commit_light_trusting_batched(
+        [(vs, CHAIN, commit, (1, 3))])
+    assert isinstance(results[0], ErrNotEnoughVotingPowerSigned)

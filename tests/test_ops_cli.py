@@ -129,3 +129,21 @@ def test_reindex_event(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "reindexed" in out and "reindexed 0" not in out
+
+
+def test_openapi_spec_covers_every_route():
+    """rpc/openapi.yaml (reference rpc/openapi/openapi.yaml) must document
+    every served route — including the unsafe tier and the WS-only
+    subscribe/unsubscribe — so the spec can't silently drift from ROUTES."""
+    import re
+
+    from tendermint_tpu.rpc.core import ROUTES, UNSAFE_ROUTES
+
+    spec_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tendermint_tpu", "rpc", "openapi.yaml")
+    text = open(spec_path).read()
+    documented = set(re.findall(r"^  /([a-z_]+):", text, re.M))
+    missing = (set(ROUTES) | set(UNSAFE_ROUTES)) - documented
+    assert not missing, f"openapi.yaml missing routes: {sorted(missing)}"
+    assert {"subscribe", "unsubscribe"} <= documented

@@ -1,0 +1,152 @@
+"""Peer trust metric + store (reference p2p/trust/{metric,store}.go).
+
+Time is injected so interval rollover is deterministic; the adversarial
+case — a flapping peer racking up errors until quarantined, then paroled
+after the ban window — is the behavior the switch wiring relies on.
+"""
+
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.p2p.trust import (
+    DEFAULT_BAN_THRESHOLD,
+    TrustMetric,
+    TrustMetricStore,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_fresh_peer_is_trusted():
+    m = TrustMetric(now=Clock())
+    assert m.value() == 1.0
+
+
+def test_good_events_keep_trust_high():
+    clk = Clock()
+    m = TrustMetric(interval=60, now=clk)
+    for _ in range(10):
+        m.record_good()
+        clk.advance(30)
+    assert m.value() > 0.9
+
+
+def test_bad_events_sink_trust():
+    clk = Clock()
+    m = TrustMetric(interval=60, now=clk)
+    for _ in range(6):
+        m.record_bad(5)
+        m.record_good(1)
+        clk.advance(60)
+    assert m.value() < 0.4
+
+
+def test_downward_trend_penalized():
+    clk = Clock()
+    good = TrustMetric(interval=60, now=clk)
+    flap = TrustMetric(interval=60, now=clk)
+    for _ in range(5):
+        good.record_good(5)
+        flap.record_good(5)
+        clk.advance(60)
+    # same history, but one starts failing NOW
+    flap.record_bad(10)
+    assert flap.value() < good.value()
+
+
+def test_long_idle_does_not_loop():
+    clk = Clock()
+    m = TrustMetric(interval=60, now=clk)
+    m.record_good()
+    clk.advance(60 * 60 * 24 * 30)  # a month idle
+    assert 0.0 <= m.value() <= 1.0  # and returns promptly
+
+
+def test_store_quarantines_flapping_peer_and_paroles():
+    clk = Clock()
+    store = TrustMetricStore(db=MemDB(), interval=60, ban_duration=600,
+                             now=clk)
+    pid = "flappy"
+    assert not store.banned(pid)
+    # errors across several intervals sink the score below the threshold
+    for _ in range(8):
+        store.peer_bad(pid, 5)
+        clk.advance(60)
+    assert store.value(pid) < DEFAULT_BAN_THRESHOLD
+    assert store.banned(pid)
+    # parole after the ban window, with a fresh metric
+    clk.advance(601)
+    assert not store.banned(pid)
+    assert store.value(pid) == 1.0
+
+
+def test_store_persists_across_restart():
+    clk = Clock()
+    db = MemDB()
+    store = TrustMetricStore(db=db, interval=60, now=clk)
+    for _ in range(8):
+        store.peer_bad("bad-peer", 5)
+        clk.advance(60)
+    store.peer_good("good-peer", 3)
+    assert store.banned("bad-peer")
+    store.save()
+
+    store2 = TrustMetricStore(db=db, interval=60, now=clk)
+    assert store2.banned("bad-peer")
+    assert store2.value("good-peer") > 0.9
+    # ban expiry survives the reload as a remaining-duration, then lapses
+    clk.advance(10_000)
+    assert not store2.banned("bad-peer")
+
+
+def test_switch_quarantines_flapping_peer():
+    """Switch wiring: repeated stop_peer_for_error sinks the peer's score
+    until the switch refuses to re-add or re-dial it (reference consults
+    the trust store on reconnect decisions)."""
+    import asyncio
+
+    from tendermint_tpu.p2p.switch import Switch
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+            self.stopped = 0
+
+        def bind(self, sw):
+            pass
+
+        def start(self):
+            pass
+
+        async def stop(self):
+            self.stopped += 1
+
+    async def run():
+        clk = Clock()
+        store = TrustMetricStore(db=MemDB(), interval=60, ban_duration=600,
+                                 now=clk)
+        sw = Switch("self-node", trust_store=store)
+        sw._running = True
+        for _ in range(10):
+            p = FakePeer("flappy")
+            sw.peers[p.id] = p
+            await sw.stop_peer_for_error(p, "bad message")
+            clk.advance(60)
+        assert store.banned("flappy")
+        # inbound connection from the quarantined peer is refused
+        p = FakePeer("flappy")
+        await sw._on_inbound_peer(p)
+        assert p.stopped == 1 and "flappy" not in sw.peers
+        # a well-behaved peer is unaffected
+        good = FakePeer("steady")
+        await sw._on_inbound_peer(good)
+        assert "steady" in sw.peers
+
+    asyncio.run(run())

@@ -1,0 +1,94 @@
+"""BaseService lifecycle semantics (reference libs/service/service.go:97
+TestBaseService* in service_test.go): start/stop idempotency errors, quit
+signaling, reset re-arming, failed-start rollback."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.libs.service import (
+    AlreadyStarted,
+    AlreadyStopped,
+    BaseService,
+    NotStarted,
+    ServiceError,
+)
+
+
+class Recorder(BaseService):
+    def __init__(self, fail_start=False):
+        super().__init__("recorder")
+        self.events = []
+        self.fail_start = fail_start
+
+    async def on_start(self):
+        if self.fail_start:
+            raise RuntimeError("boom")
+        self.events.append("start")
+
+    async def on_stop(self):
+        self.events.append("stop")
+
+
+def test_start_stop_cycle_and_errors():
+    async def run():
+        s = Recorder()
+        assert not s.is_running() and "new" in str(s)
+        await s.start()
+        assert s.is_running()
+        with pytest.raises(AlreadyStarted):
+            await s.start()
+        await s.stop()
+        assert not s.is_running() and "stopped" in str(s)
+        with pytest.raises(AlreadyStopped):
+            await s.stop()
+        with pytest.raises(AlreadyStopped):
+            await s.start()  # stopped services need reset first
+        assert s.events == ["start", "stop"]
+
+    asyncio.run(run())
+
+
+def test_wait_unblocks_on_stop():
+    async def run():
+        s = Recorder()
+        await s.start()
+        waiter = asyncio.create_task(s.wait())
+        await asyncio.sleep(0)
+        assert not waiter.done()
+        await s.stop()
+        await asyncio.wait_for(waiter, 1)
+
+    asyncio.run(run())
+
+
+def test_reset_rearms():
+    async def run():
+        s = Recorder()
+        with pytest.raises(ServiceError):
+            await s.reset()  # not stopped yet
+        await s.start()
+        with pytest.raises(ServiceError):
+            await s.reset()  # running
+        await s.stop()
+        await s.reset()
+        await s.start()
+        assert s.is_running()
+        assert s.events == ["start", "stop", "start"]
+
+    asyncio.run(run())
+
+
+def test_failed_start_rolls_back():
+    async def run():
+        s = Recorder(fail_start=True)
+        with pytest.raises(RuntimeError):
+            await s.start()
+        assert not s.is_running()
+        s.fail_start = False
+        await s.start()  # recoverable
+        assert s.is_running()
+        with pytest.raises(NotStarted):
+            await Recorder().wait()
+
+    asyncio.run(run())

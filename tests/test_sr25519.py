@@ -91,3 +91,55 @@ def test_params_accept_sr25519():
     bad.validator = ValidatorParams(["bogus"])
     with pytest.raises(ValueError):
         bad.validate_basic()
+
+
+# -- cross-implementation KATs -----------------------------------------------
+#
+# The reference's sr25519 is ChainSafe/go-schnorrkel (crypto/sr25519/
+# privkey.go:10,28). No Go toolchain or schnorrkel port exists in this
+# environment, so a dependency-GENERATED signature fixture cannot be minted
+# here; interop is instead pinned at every deterministic layer:
+#   1. ristretto255 group: RFC 9496 A.1/A.2 (above);
+#   2. merlin/STROBE transcript: the canonical merlin conformance vector
+#      (test_p2p_tcp.py::test_merlin_transcript_matches_upstream_vector,
+#      "test protocol"/"some data" -> d5a21972...);
+#   3. ExpandEd25519 + ristretto basepoint mul: the known schnorrkel keypair
+#      below, produced by the wasm schnorrkel build in polkadot-js's test
+#      suite — if our expansion, cofactor division, or encoding diverged in
+#      any bit this would not match;
+#   4. the signing transcript labels (SigningContext / "" / sign-bytes /
+#      proto-name=Schnorr-sig / sign:pk / sign:R / sign:c, 64-byte wide
+#      reduction) audited line-by-line against go-schnorrkel's
+#      NewSigningContext and Sign (privkey.go:34).
+# Signatures themselves are randomized (schnorrkel draws a witness from a
+# transcript RNG), so even go-schnorrkel emits different bytes per call —
+# there is no canonical signature vector to pin, only the acceptance
+# predicate, which layers 1-4 determine completely.
+
+KNOWN_MINI = "fac7959dbfe72f052e5a0c3c8d6530f202b02fd8f9f5ca3580ec8deb7797479e"
+KNOWN_PUB = "46ebddef8cd9bb167dc30878d7113b7e168e6f0646beffd77d69d39bad76b47a"
+
+
+def test_known_schnorrkel_keypair():
+    mini = bytes.fromhex(KNOWN_MINI)
+    assert sr25519.pubkey_from_mini(mini).hex() == KNOWN_PUB
+
+
+def test_known_keypair_signs_and_verifies():
+    mini = bytes.fromhex(KNOWN_MINI)
+    sig = sr25519.sign(mini, b"hello", ctx=b"")
+    assert sr25519.verify(bytes.fromhex(KNOWN_PUB), b"hello", sig)
+
+
+def test_challenge_scalar_frozen_regression():
+    """Self-generated (NOT cross-impl) pin of the full signing transcript:
+    the challenge k for a fixed (ctx, msg, pk, R). Any future drift in the
+    transcript composition — label bytes, framing, wide reduction — changes
+    this value. Frozen at round 5."""
+    t = sr25519.signing_context(b"ctx", b"msg")
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", bytes.fromhex(KNOWN_PUB))
+    t.append_message(b"sign:R", bytes(32))
+    k = sr25519._challenge_scalar(t, b"sign:c")
+    assert format(k, "064x") == (
+        "08bf8b3b227353c0b39d3ba1edebee6da28f8ab5a4aed7c6f9efd194989b5b3a")
