@@ -219,11 +219,20 @@ def bench_light_chain_1000():
     semantics against the shared precomputed verdicts (the same dual-plane
     dedup the fast-sync reactor applies per window). Sign-bytes are built
     once per commit via the shared-field batch encoder. The metric's sig
-    count is the UNIQUE signatures verified (n_headers x n_vals); the host
-    baseline performs the same two verification kinds through the identical
-    seam with the scalar backend, so vs_baseline compares equal semantic
-    work. (The helpers' own internal dispatch path is exercised by config
-    #5's plane metric and the test suite.)"""
+    count is the UNIQUE signatures verified (n_headers x n_vals).
+
+    vs_baseline is EQUAL WORK: the host baseline runs the identical dedup
+    structure (one pass over unique signatures, scalar backend, then both
+    replays) — a scalar implementation could memoize the same way, so the
+    headline ratio credits only the crypto plane. This also approximates
+    the reference's TRUE scalar cost: its early-exiting loops verify ~1001
+    sigs/header (1/3 tally for trusting + 2/3 for light,
+    validator_set.go:722,775) vs the 1000 unique here. The extra field
+    vs_undeduped_scalar keeps round-over-round continuity with the r1-r4
+    methodology, whose baseline pushed ALL candidates through the seam once
+    per verification kind (~2x the unique set). (The helpers' own internal
+    dispatch path is exercised by config #5's plane metric and the test
+    suite.)"""
     from tendermint_tpu.types.validator_set import (
         verify_commit_light_batched,
         verify_commit_light_trusting_batched,
@@ -241,7 +250,8 @@ def bench_light_chain_1000():
         for c in commits:
             c.__dict__.pop("_sb_cache", None)
 
-    def verify_chain_device():
+    def verify_chain_deduped(backend: str):
+        from tendermint_tpu.crypto import batch as crypto_batch
         from tendermint_tpu.crypto.batch import (
             BatchVerifier,
             precomputed_verdicts,
@@ -249,9 +259,9 @@ def bench_light_chain_1000():
 
         _fresh_commits()
         # both verification kinds check the SAME candidate signatures, so
-        # one segmented device call serves trusting AND light (the same
+        # one verification pass serves trusting AND light (the same
         # dual-plane pattern the fast-sync reactor uses per window)
-        bv = BatchVerifier(backend="jax")
+        bv = BatchVerifier(backend=backend)
         verdict_keys = []
         for c in commits:
             sb = c.vote_sign_bytes_all("bench-light")
@@ -263,6 +273,7 @@ def bench_light_chain_1000():
         _, verdicts = bv.verify()
         token = precomputed_verdicts.set(
             {k: bool(v) for k, v in zip(verdict_keys, verdicts)})
+        pre_before = crypto_batch.stats["precomputed_batches"]
         try:
             errs = verify_commit_light_trusting_batched(
                 [(vs, "bench-light", c, trust) for c in commits])
@@ -273,24 +284,31 @@ def bench_light_chain_1000():
             assert all(e is None for e in errs), errs
         finally:
             precomputed_verdicts.reset(token)
+        # guard the metric: a key mismatch would silently re-dispatch the
+        # whole batch inside the timed region instead of replaying verdicts
+        assert crypto_batch.stats["precomputed_batches"] == pre_before + 2, \
+            "precomputed verdicts missed: bench would measure re-dispatch"
 
-    def verify_chain():
+    def verify_chain_undeduped_host():
         _fresh_commits()
         for c in commits:
             vs.verify_commit_light_trusting("bench-light", c, trust)
             vs.verify_commit_light("bench-light", c.block_id, c.height, c)
 
-    dev = _timed(verify_chain_device)
+    dev = _timed(lambda: verify_chain_deduped("jax"))
     os.environ["TMTPU_BATCH_BACKEND"] = "host"
     try:
-        host = _timed(verify_chain, warm=0, runs=1)
+        # equal work: the SAME dedup structure on the scalar backend
+        host = _timed(lambda: verify_chain_deduped("host"), warm=0, runs=1)
+        # the reference-shaped seam: each kind verifies its candidates
+        host2x = _timed(verify_chain_undeduped_host, warm=0, runs=1)
     finally:
         del os.environ["TMTPU_BATCH_BACKEND"]
     # unique candidate signatures verified per pass (the honest numerator:
     # both verification kinds share the same signatures, verified once)
     sigs = n_headers * n_vals
     _emit("light_chain_1000_vals_sigs_per_sec", sigs / dev, "sigs/s",
-          host / dev)
+          host / dev, vs_undeduped_scalar=round(host2x / dev, 3))
 
 
 def bench_fast_sync_replay():

@@ -1,0 +1,175 @@
+"""XSalsa20-Poly1305 secretbox + legacy symmetric encryption
+(reference crypto/xsalsa20symmetric/symmetric.go over nacl/secretbox).
+
+Pure Python: these functions protect legacy ASCII-armored key files — a few
+hundred bytes decrypted at CLI time — so clarity beats speed. Layout is
+NaCl's exactly: ``encrypt_symmetric`` output is nonce(24) || tag(16) ||
+cipher, with secret = SHA-256-shaped 32 bytes (the reference documents
+"Sha256(Bcrypt(passphrase))"; see kdf()).
+
+Primitives from their specs:
+* Salsa20 core & stream (Bernstein, salsa20-ref.c semantics);
+* HSalsa20 for the XSalsa20 nonce extension (NaCl paper, §10);
+* Poly1305 over 2^130 - 5 (pinned to the RFC 8439 §2.5.2 vector);
+* secretbox_seal/open pinned to the canonical NaCl test vector.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+from typing import Optional
+
+NONCE_LEN = 24
+SECRET_LEN = 32
+OVERHEAD = 16  # poly1305 tag
+
+_SIGMA = b"expand 32-byte k"
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+
+def _quarterround(y0, y1, y2, y3):
+    y1 ^= _rotl((y0 + y3) & 0xFFFFFFFF, 7)
+    y2 ^= _rotl((y1 + y0) & 0xFFFFFFFF, 9)
+    y3 ^= _rotl((y2 + y1) & 0xFFFFFFFF, 13)
+    y0 ^= _rotl((y3 + y2) & 0xFFFFFFFF, 18)
+    return y0, y1, y2, y3
+
+
+def _doubleround(x):
+    # columnround
+    x[0], x[4], x[8], x[12] = _quarterround(x[0], x[4], x[8], x[12])
+    x[5], x[9], x[13], x[1] = _quarterround(x[5], x[9], x[13], x[1])
+    x[10], x[14], x[2], x[6] = _quarterround(x[10], x[14], x[2], x[6])
+    x[15], x[3], x[7], x[11] = _quarterround(x[15], x[3], x[7], x[11])
+    # rowround
+    x[0], x[1], x[2], x[3] = _quarterround(x[0], x[1], x[2], x[3])
+    x[5], x[6], x[7], x[4] = _quarterround(x[5], x[6], x[7], x[4])
+    x[10], x[11], x[8], x[9] = _quarterround(x[10], x[11], x[8], x[9])
+    x[15], x[12], x[13], x[14] = _quarterround(x[15], x[12], x[13], x[14])
+
+
+def _core_words(key: bytes, inp: bytes):
+    """Salsa20 state words for key(32) and input(16): the 4x4 matrix with
+    the sigma constant on the diagonal."""
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<4I", inp)
+    c = struct.unpack("<4I", _SIGMA)
+    return [c[0], k[0], k[1], k[2],
+            k[3], c[1], n[0], n[1],
+            n[2], n[3], c[2], k[4],
+            k[5], k[6], k[7], c[3]]
+
+
+def salsa20_block(key: bytes, inp: bytes) -> bytes:
+    """Salsa20 hash: 20 rounds + feed-forward (the stream block)."""
+    x0 = _core_words(key, inp)
+    x = list(x0)
+    for _ in range(10):
+        _doubleround(x)
+    return struct.pack("<16I", *((a + b) & 0xFFFFFFFF
+                                 for a, b in zip(x, x0)))
+
+
+def hsalsa20(key: bytes, inp: bytes) -> bytes:
+    """HSalsa20: 20 rounds, NO feed-forward; output words 0,5,10,15,6,7,8,9
+    (NaCl paper — the XSalsa20 subkey derivation)."""
+    x = _core_words(key, inp)
+    for _ in range(10):
+        _doubleround(x)
+    return struct.pack("<8I", x[0], x[5], x[10], x[15],
+                       x[6], x[7], x[8], x[9])
+
+
+def salsa20_stream(key: bytes, nonce8: bytes, length: int) -> bytes:
+    out = bytearray()
+    counter = 0
+    while len(out) < length:
+        inp = nonce8 + struct.pack("<Q", counter)
+        out += salsa20_block(key, inp)
+        counter += 1
+    return bytes(out[:length])
+
+
+def poly1305(key32: bytes, msg: bytes) -> bytes:
+    """RFC 8439 §2.5 one-time MAC over 2^130 - 5."""
+    r = int.from_bytes(key32[:16], "little")
+    r &= 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        blk = msg[i:i + 16]
+        n = int.from_bytes(blk + b"\x01", "little")
+        acc = (acc + n) * r % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _xsalsa20_key_nonce(key: bytes, nonce: bytes):
+    subkey = hsalsa20(key, nonce[:16])
+    return subkey, nonce[16:24]
+
+
+def secretbox_seal(msg: bytes, nonce: bytes, key: bytes) -> bytes:
+    """NaCl crypto_secretbox: returns tag(16) || cipher."""
+    if len(key) != SECRET_LEN or len(nonce) != NONCE_LEN:
+        raise ValueError("secretbox needs 32-byte key, 24-byte nonce")
+    subkey, n8 = _xsalsa20_key_nonce(key, nonce)
+    stream = salsa20_stream(subkey, n8, 32 + len(msg))
+    cipher = bytes(a ^ b for a, b in zip(msg, stream[32:]))
+    tag = poly1305(stream[:32], cipher)
+    return tag + cipher
+
+
+def secretbox_open(boxed: bytes, nonce: bytes, key: bytes) -> Optional[bytes]:
+    """-> plaintext, or None on authentication failure."""
+    if len(key) != SECRET_LEN or len(nonce) != NONCE_LEN:
+        raise ValueError("secretbox needs 32-byte key, 24-byte nonce")
+    if len(boxed) < OVERHEAD:
+        return None
+    tag, cipher = boxed[:OVERHEAD], boxed[OVERHEAD:]
+    subkey, n8 = _xsalsa20_key_nonce(key, nonce)
+    stream = salsa20_stream(subkey, n8, 32 + len(cipher))
+    want = poly1305(stream[:32], cipher)
+    # constant-time-ish compare (hmac.compare_digest semantics)
+    import hmac
+
+    if not hmac.compare_digest(tag, want):
+        return None
+    return bytes(a ^ b for a, b in zip(cipher, stream[32:]))
+
+
+# -- the reference's symmetric seam (symmetric.go:19,36) ---------------------
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """nonce(24) || secretbox(tag+cipher); secret must be 32 bytes."""
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"secret must be 32 bytes long, got {len(secret)}")
+    nonce = os.urandom(NONCE_LEN)
+    return nonce + secretbox_seal(plaintext, nonce, secret)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"secret must be 32 bytes long, got {len(secret)}")
+    if len(ciphertext) <= OVERHEAD + NONCE_LEN:
+        raise ValueError("ciphertext is too short")
+    out = secretbox_open(ciphertext[NONCE_LEN:], ciphertext[:NONCE_LEN],
+                         secret)
+    if out is None:
+        raise ValueError("ciphertext decryption failed")
+    return out
+
+
+def kdf(passphrase: str, salt: bytes = b"") -> bytes:
+    """Passphrase -> 32-byte secret. The reference documents
+    "Sha256(Bcrypt(passphrase))" (symmetric.go:17); bcrypt is unavailable
+    in this image, so the work factor comes from PBKDF2-HMAC-SHA256 with a
+    cost comparable to bcrypt(12). Key files record which KDF produced
+    them, so formats stay self-describing."""
+    return hashlib.pbkdf2_hmac("sha256", passphrase.encode(), salt,
+                               200_000, dklen=32)

@@ -1,0 +1,189 @@
+"""UPnP NAT traversal (reference p2p/upnp/{upnp,probe}.go).
+
+NAT seam: ``discover()`` finds an Internet Gateway Device via SSDP
+multicast, resolves its WAN(IP|PPP)Connection control URL from the root
+description XML, and returns a :class:`UPnPNAT` speaking the three SOAP
+actions the reference uses — GetExternalIPAddress, AddPortMapping,
+DeletePortMapping (upnp.go:301,347,384). ``probe()`` mirrors probe.go's
+capability check: map a port, report external address, unmap.
+
+Stdlib only (socket + urllib + ElementTree). Everything network-y takes an
+injectable endpoint so tests run against an in-proc fake IGD — real
+gateways obviously don't exist in CI. The node treats UPnP as best-effort:
+any failure here degrades to manual port forwarding, never to a crash
+(cmd start's laddr binding does not depend on it).
+"""
+
+from __future__ import annotations
+
+import socket
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Optional, Tuple
+from urllib.parse import urljoin, urlparse
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+_SEARCH_TARGET = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+_WAN_SERVICES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class UPnPError(Exception):
+    pass
+
+
+def _msearch(timeout: float, ssdp_addr) -> Optional[str]:
+    """One SSDP M-SEARCH round; returns the LOCATION header or None."""
+    msg = ("M-SEARCH * HTTP/1.1\r\n"
+           f"HOST: {ssdp_addr[0]}:{ssdp_addr[1]}\r\n"
+           'MAN: "ssdp:discover"\r\n'
+           f"ST: {_SEARCH_TARGET}\r\n"
+           "MX: 2\r\n\r\n").encode()
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(timeout)
+        s.sendto(msg, ssdp_addr)
+        try:
+            data, _peer = s.recvfrom(4096)
+        except socket.timeout:
+            return None
+    for line in data.decode(errors="replace").split("\r\n"):
+        k, _, v = line.partition(":")
+        if k.strip().lower() == "location":
+            return v.strip()
+    return None
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _find_control_url(desc_xml: bytes, base_url: str) -> Tuple[str, str]:
+    """Walk the device tree for a WAN(IP|PPP)Connection service
+    (upnp.go:159 getChildDevice / :169 getChildService)."""
+    root = ET.fromstring(desc_xml)
+    for svc in root.iter():
+        if _strip_ns(svc.tag) != "service":
+            continue
+        stype = ctrl = ""
+        for child in svc:
+            if _strip_ns(child.tag) == "serviceType":
+                stype = (child.text or "").strip()
+            elif _strip_ns(child.tag) == "controlURL":
+                ctrl = (child.text or "").strip()
+        if stype in _WAN_SERVICES and ctrl:
+            return urljoin(base_url, ctrl), stype
+    raise UPnPError("no WANIPConnection/WANPPPConnection service found")
+
+
+def _soap_call(control_url: str, service_type: str, action: str,
+               args: dict, timeout: float = 5.0) -> ET.Element:
+    body = "".join(f"<{k}>{v}</{k}>" for k, v in args.items())
+    envelope = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f'<s:Body><u:{action} xmlns:u="{service_type}">{body}</u:{action}>'
+        "</s:Body></s:Envelope>").encode()
+    req = urllib.request.Request(control_url, data=envelope, headers={
+        "Content-Type": 'text/xml; charset="utf-8"',
+        "SOAPAction": f'"{service_type}#{action}"',
+    })
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return ET.fromstring(resp.read())
+    except urllib.error.HTTPError as e:
+        raise UPnPError(f"{action} failed: HTTP {e.code}") from None
+    except Exception as e:
+        raise UPnPError(f"{action} failed: {e}") from None
+
+
+def _local_ipv4(gateway_host: str) -> str:
+    """The local address a packet to the gateway would use (upnp.go:179)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.connect((gateway_host, 1900))
+        return s.getsockname()[0]
+
+
+@dataclass
+class UPnPNAT:
+    """The reference's NAT interface (upnp.go:29)."""
+
+    control_url: str
+    service_type: str
+
+    def get_external_address(self) -> str:
+        doc = _soap_call(self.control_url, self.service_type,
+                         "GetExternalIPAddress", {})
+        for el in doc.iter():
+            if _strip_ns(el.tag) == "NewExternalIPAddress":
+                if not el.text:
+                    raise UPnPError("gateway returned empty external IP")
+                return el.text.strip()
+        raise UPnPError("no NewExternalIPAddress in response")
+
+    def add_port_mapping(self, protocol: str, external_port: int,
+                         internal_port: int, description: str,
+                         lease_seconds: int = 0) -> int:
+        host = urlparse(self.control_url).hostname or ""
+        _soap_call(self.control_url, self.service_type, "AddPortMapping", {
+            "NewRemoteHost": "",
+            "NewExternalPort": external_port,
+            "NewProtocol": protocol.upper(),
+            "NewInternalPort": internal_port,
+            "NewInternalClient": _local_ipv4(host),
+            "NewEnabled": 1,
+            "NewPortMappingDescription": description,
+            "NewLeaseDuration": lease_seconds,
+        })
+        return external_port
+
+    def delete_port_mapping(self, protocol: str, external_port: int) -> None:
+        _soap_call(self.control_url, self.service_type, "DeletePortMapping", {
+            "NewRemoteHost": "",
+            "NewExternalPort": external_port,
+            "NewProtocol": protocol.upper(),
+        })
+
+
+def discover(timeout: float = 3.0, ssdp_addr=SSDP_ADDR,
+             attempts: int = 2) -> UPnPNAT:
+    """(upnp.go:39 Discover) SSDP -> description fetch -> control URL."""
+    location = None
+    for _ in range(attempts):
+        location = _msearch(timeout, ssdp_addr)
+        if location:
+            break
+    if not location:
+        raise UPnPError("no UPnP gateway answered the SSDP search")
+    try:
+        with urllib.request.urlopen(location, timeout=timeout) as resp:
+            desc = resp.read()
+    except Exception as e:
+        raise UPnPError(f"could not fetch device description: {e}") from None
+    control_url, service_type = _find_control_url(desc, location)
+    return UPnPNAT(control_url=control_url, service_type=service_type)
+
+
+def probe(int_port: int = 26656, ext_port: int = 26656,
+          timeout: float = 3.0, ssdp_addr=SSDP_ADDR) -> dict:
+    """(probe.go:90 Probe) capability check: discover, map, read external
+    address, unmap. Returns {external_ip, port_mapping} — hairpin testing
+    needs a second vantage point and is out of scope, like the reference's
+    testHairpin which requires a live dial-back."""
+    nat = discover(timeout=timeout, ssdp_addr=ssdp_addr)
+    caps = {"external_ip": None, "port_mapping": False}
+    try:
+        caps["external_ip"] = nat.get_external_address()
+    except UPnPError:
+        pass
+    try:
+        nat.add_port_mapping("tcp", ext_port, int_port, "tendermint-tpu probe",
+                             lease_seconds=60)
+        caps["port_mapping"] = True
+        nat.delete_port_mapping("tcp", ext_port)
+    except UPnPError:
+        pass
+    return caps

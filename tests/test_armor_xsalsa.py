@@ -1,0 +1,85 @@
+"""Legacy key-file crypto (reference crypto/armor/armor.go,
+crypto/xsalsa20symmetric/symmetric.go): primitive KATs + armor framing +
+the encrypted-key round trip."""
+
+import pytest
+
+from tendermint_tpu.crypto import armor, xsalsa20
+
+
+def test_poly1305_rfc8439_vector():
+    key = bytes.fromhex("85d6be7857556d337f4452fe42d506a8"
+                        "0103808afb0db2fd4abff6af4149f51b")
+    tag = xsalsa20.poly1305(key, b"Cryptographic Forum Research Group")
+    assert tag.hex() == "a8061dc1305136c6c22b8baf0c0127a9"
+
+
+def test_secretbox_nacl_vector():
+    """The canonical NaCl secretbox test vector (tests/secretbox.c):
+    reproducing its ciphertext pins XSalsa20 (HSalsa20 subkey + Salsa20
+    stream) AND the poly1305-over-first-32-stream-bytes layout."""
+    k = bytes.fromhex("1b27556473e985d462cd51197a9a46c7"
+                      "6009549eac6474f206c4ee0844f68389")
+    nonce = bytes.fromhex("69696ee955b62b73cd62bda875fc73d68219e0036b7a0b37")
+    m = bytes.fromhex(
+        "be075fc53c81f2d5cf141316ebeb0c7b5228c52a4c62cbd44b66849b64244ffc"
+        "e5ecbaaf33bd751a1ac728d45e6c61296cdc3c01233561f41db66cce314adb31"
+        "0e3be8250c46f06dceea3a7fa1348057e2f6556ad6b1318a024a838f21af1fde"
+        "048977eb48f59ffd4924ca1c60902e52f0a089bc76897040e082f93776384864"
+        "5e0705")
+    c = xsalsa20.secretbox_seal(m, nonce, k)
+    assert c[:32].hex() == ("f3ffc7703f9400e52a7dfb4b3d3305d9"
+                            "8e993b9f48681273c29650ba32fc76ce")
+    assert xsalsa20.secretbox_open(c, nonce, k) == m
+    bad = bytearray(c)
+    bad[40] ^= 1
+    assert xsalsa20.secretbox_open(bytes(bad), nonce, k) is None
+
+
+def test_symmetric_seam_matches_reference_shape():
+    secret = bytes(range(32))
+    ct = xsalsa20.encrypt_symmetric(b"legacy key bytes", secret)
+    # nonce(24) + overhead(16) + len(pt), like symmetric.go documents
+    assert len(ct) == 24 + 16 + len(b"legacy key bytes")
+    assert xsalsa20.decrypt_symmetric(ct, secret) == b"legacy key bytes"
+    with pytest.raises(ValueError):
+        xsalsa20.decrypt_symmetric(ct[:30], secret)
+    with pytest.raises(ValueError):
+        xsalsa20.decrypt_symmetric(ct, bytes(31))
+    wrong = bytes(reversed(range(32)))
+    with pytest.raises(ValueError):
+        xsalsa20.decrypt_symmetric(ct, wrong)
+
+
+def test_armor_round_trip_and_framing():
+    data = bytes(range(200))
+    s = armor.encode_armor("TEST BLOCK", {"Version": "1", "Alg": "x"}, data)
+    assert s.startswith("-----BEGIN TEST BLOCK-----\n")
+    assert "-----END TEST BLOCK-----" in s
+    assert max(len(ln) for ln in s.splitlines()) <= 64 + 12
+    bt, headers, out = armor.decode_armor(s)
+    assert bt == "TEST BLOCK" and out == data
+    assert headers == {"Version": "1", "Alg": "x"}
+
+    # checksum protects the body
+    lines = s.splitlines()
+    body_idx = next(i for i, ln in enumerate(lines)
+                    if ln and not ln.startswith("-") and ":" not in ln)
+    corrupted = list(lines)
+    corrupted[body_idx] = ("B" + corrupted[body_idx][1:]
+                           if corrupted[body_idx][0] != "B"
+                           else "C" + corrupted[body_idx][1:])
+    with pytest.raises(ValueError, match="CRC24|body"):
+        armor.decode_armor("\n".join(corrupted))
+    with pytest.raises(ValueError, match="BEGIN"):
+        armor.decode_armor("not armor at all")
+
+
+def test_encrypted_privkey_round_trip():
+    priv = bytes(range(64))
+    s = armor.encrypt_armor_priv_key(priv, "hunter2", key_type="ed25519")
+    assert "TENDERMINT PRIVATE KEY" in s and "salt" in s.lower()
+    out, ktype = armor.unarmor_decrypt_priv_key(s, "hunter2")
+    assert out == priv and ktype == "ed25519"
+    with pytest.raises(ValueError, match="passphrase"):
+        armor.unarmor_decrypt_priv_key(s, "wrong")

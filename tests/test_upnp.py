@@ -1,0 +1,157 @@
+"""UPnP NAT traversal (reference p2p/upnp) against an in-proc fake IGD:
+a unicast SSDP responder + an HTTP server serving the rootDesc XML and a
+SOAP control endpoint. Real gateways don't exist in CI; the fake speaks
+the same three actions the reference uses."""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tendermint_tpu.p2p import upnp
+
+DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device>
+   <deviceType>urn:schemas-upnp-org:device:WANDevice:1</deviceType>
+   <deviceList><device>
+    <deviceType>urn:schemas-upnp-org:device:WANConnectionDevice:1</deviceType>
+    <serviceList><service>
+     <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+     <controlURL>/ctl/IPConn</controlURL>
+    </service></serviceList>
+   </device></deviceList>
+  </device></deviceList>
+ </device>
+</root>"""
+
+
+class FakeIGD:
+    def __init__(self):
+        self.mappings = {}
+        self.requests = []
+
+        igd = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/rootDesc.xml":
+                    body = DESC_XML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))).decode()
+                action = self.headers.get("SOAPAction", "").strip('"')
+                igd.requests.append((action, body))
+                name = action.rsplit("#", 1)[-1]
+                if name == "GetExternalIPAddress":
+                    inner = ("<NewExternalIPAddress>203.0.113.7"
+                             "</NewExternalIPAddress>")
+                elif name == "AddPortMapping":
+                    import re
+
+                    port = re.search(r"<NewExternalPort>(\d+)", body).group(1)
+                    proto = re.search(r"<NewProtocol>(\w+)", body).group(1)
+                    igd.mappings[(proto, int(port))] = body
+                    inner = ""
+                elif name == "DeletePortMapping":
+                    import re
+
+                    port = re.search(r"<NewExternalPort>(\d+)", body).group(1)
+                    proto = re.search(r"<NewProtocol>(\w+)", body).group(1)
+                    if (proto, int(port)) not in igd.mappings:
+                        self.send_error(500)
+                        return
+                    del igd.mappings[(proto, int(port))]
+                    inner = ""
+                else:
+                    self.send_error(500)
+                    return
+                resp = (f'<?xml version="1.0"?><s:Envelope xmlns:s='
+                        f'"http://schemas.xmlsoap.org/soap/envelope/">'
+                        f'<s:Body><u:{name}Response xmlns:u='
+                        f'"urn:schemas-upnp-org:service:WANIPConnection:1">'
+                        f"{inner}</u:{name}Response>"
+                        f"</s:Body></s:Envelope>").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+        self.http = HTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.http.server_address[1]
+        threading.Thread(target=self.http.serve_forever, daemon=True).start()
+
+        # unicast SSDP responder standing in for the multicast group
+        self.ssdp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.ssdp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.ssdp.getsockname()
+
+        def ssdp_loop():
+            while True:
+                try:
+                    data, peer = self.ssdp.recvfrom(4096)
+                except OSError:
+                    return
+                if b"M-SEARCH" in data:
+                    resp = ("HTTP/1.1 200 OK\r\n"
+                            "ST: urn:schemas-upnp-org:device:"
+                            "InternetGatewayDevice:1\r\n"
+                            f"LOCATION: http://127.0.0.1:{self.http_port}"
+                            "/rootDesc.xml\r\n\r\n").encode()
+                    self.ssdp.sendto(resp, peer)
+
+        threading.Thread(target=ssdp_loop, daemon=True).start()
+
+    def stop(self):
+        self.http.shutdown()
+        self.ssdp.close()
+
+
+@pytest.fixture
+def igd():
+    f = FakeIGD()
+    yield f
+    f.stop()
+
+
+def test_discover_and_map(igd):
+    nat = upnp.discover(timeout=2.0, ssdp_addr=igd.ssdp_addr)
+    assert nat.service_type.endswith("WANIPConnection:1")
+    assert nat.get_external_address() == "203.0.113.7"
+    nat.add_port_mapping("tcp", 26656, 26656, "tmtpu", lease_seconds=0)
+    assert ("TCP", 26656) in igd.mappings
+    assert "<NewInternalClient>127.0.0.1" in igd.mappings[("TCP", 26656)]
+    nat.delete_port_mapping("tcp", 26656)
+    assert not igd.mappings
+    # deleting a mapping that doesn't exist surfaces as UPnPError
+    with pytest.raises(upnp.UPnPError):
+        nat.delete_port_mapping("tcp", 26656)
+
+
+def test_probe_capabilities(igd):
+    caps = upnp.probe(int_port=26656, ext_port=26700, timeout=2.0,
+                      ssdp_addr=igd.ssdp_addr)
+    assert caps == {"external_ip": "203.0.113.7", "port_mapping": True}
+    assert not igd.mappings  # probe unmaps after itself
+
+
+def test_discover_times_out_without_gateway():
+    lonely = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    lonely.bind(("127.0.0.1", 0))
+    addr = lonely.getsockname()
+    lonely.close()  # nobody listening
+    with pytest.raises(upnp.UPnPError, match="no UPnP gateway"):
+        upnp.discover(timeout=0.3, ssdp_addr=addr, attempts=1)
