@@ -23,6 +23,10 @@ def _validators_key(h: int) -> bytes:
     return b"validatorsKey:" + str(h).encode()
 
 
+# resolution floor for validator change-pointers after pruning
+_VALS_CHECKPOINT_KEY = b"validatorsCheckpoint"
+
+
 def _params_key(h: int) -> bytes:
     return b"consensusParamsKey:" + str(h).encode()
 
@@ -134,7 +138,8 @@ class StateStore:
         if state.last_block_height == 0:  # genesis bootstrap
             next_height = state.initial_height
             self._save_validators(next_height, state.validators)
-        self._save_validators(next_height + 1, state.next_validators)
+        self._save_validators(next_height + 1, state.next_validators,
+                              last_changed=state.last_height_validators_changed)
         self._save_params(next_height, state.consensus_params,
                           state.last_height_consensus_params_changed)
         self._db.set(_STATE_KEY, _state_to_json(state))
@@ -160,17 +165,54 @@ class StateStore:
 
     # -- validators (with change-height dedup, state/store.go:289) --
 
-    def _save_validators(self, height: int, vals: ValidatorSet) -> None:
+    def _save_validators(self, height: int, vals: ValidatorSet,
+                         last_changed: Optional[int] = None) -> None:
+        """Full set only at its change height; unchanged heights store just
+        the pointer (saveValidatorsInfo, store.go:289) — re-encoding a
+        1000-validator set every block was ~1/3 of the store's per-block
+        cost, for bytes that never change. A pointer is only written when
+        its target record actually holds a full set: rollback can rewrite
+        change heights such that the natural target is itself a pointer,
+        and a pointer chain would make the height unloadable."""
+        if last_changed is None or last_changed >= height:
+            last_changed = height
+        if height != last_changed:
+            target = self._db.get(_validators_key(last_changed))
+            if target is not None and b'"set"' in target:
+                self._db.set(_validators_key(height), json.dumps(
+                    {"last_changed": last_changed}).encode())
+                return
+            # unresolvable target: materialize (self-healing, no chains)
         self._db.set(_validators_key(height), json.dumps({
             "last_changed": height, "set": vals.encode().hex(),
         }).encode())
 
     def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        """(loadValidators, store.go:249) follow the change pointer, then
+        roll proposer priorities forward to the requested height."""
         raw = self._db.get(_validators_key(height))
         if raw is None:
             return None
         d = json.loads(raw.decode())
-        return ValidatorSet.decode(bytes.fromhex(d["set"]))
+        if "set" in d:
+            return ValidatorSet.decode(bytes.fromhex(d["set"]))
+        last_changed = int(d["last_changed"])
+        # pruning may have dropped the original change-height record; the
+        # checkpoint written by prune_states is the resolution floor
+        ckpt_raw = self._db.get(_VALS_CHECKPOINT_KEY)
+        if ckpt_raw is not None:
+            last_changed = max(last_changed, int(ckpt_raw))
+        if last_changed > height:
+            return None
+        raw2 = self._db.get(_validators_key(last_changed))
+        if raw2 is None:
+            return None
+        d2 = json.loads(raw2.decode())
+        if "set" not in d2:
+            return None
+        vals = ValidatorSet.decode(bytes.fromhex(d2["set"]))
+        vals.increment_proposer_priority(height - last_changed)
+        return vals
 
     # -- consensus params --
 
@@ -197,6 +239,24 @@ class StateStore:
 
     def prune_states(self, retain_height: int) -> None:
         """Drop per-height records below retain_height (state/store.go PruneStates)."""
+        # checkpoint first: validator records at/above the retain height may
+        # be change-pointers into the range being pruned — materialize a
+        # full set at retain_height and record it as the resolution floor
+        # (the reference's loadValidators clamps pointer targets to its
+        # checkpoint the same way, store.go lastStoredHeightFor). Skip the
+        # decode/re-encode when the record is already full: prune runs per
+        # commit on retention-configured nodes, and re-materializing every
+        # block would re-add the cost the pointer scheme removed.
+        raw = self._db.get(_validators_key(retain_height))
+        if raw is not None and b'"set"' not in raw:
+            keep = self.load_validators(retain_height)
+            if keep is not None:
+                self._db.set(_validators_key(retain_height), json.dumps({
+                    "last_changed": retain_height,
+                    "set": keep.encode().hex(),
+                }).encode())
+        if raw is not None:
+            self._db.set(_VALS_CHECKPOINT_KEY, str(retain_height).encode())
         deletes: List[bytes] = []
         for key_fn in (_validators_key, _params_key, _abci_responses_key):
             prefix = key_fn(0).rsplit(b":", 1)[0] + b":"

@@ -162,3 +162,99 @@ def test_kvstore_validator_update_tx(chain):
     # validator set now has 2 members at the height after next
     assert new_state.next_validators.size() == 2
     assert new_state.validators.size() == 1
+
+
+def _mk_pointer_valset(n=5, seed=3, base_power=10):
+    import numpy as np
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    rng = np.random.default_rng(seed)
+    vals = []
+    for i in range(n):
+        sk = Ed25519PrivateKey.from_private_bytes(
+            bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+        pub = crypto.Ed25519PubKey(sk.public_key().public_bytes_raw())
+        vals.append(Validator(pub.address(), pub, base_power + i))
+    return ValidatorSet(vals)
+
+
+def test_validators_change_pointer_dedup():
+    """(store.go:289 saveValidatorsInfo / :249 loadValidators) unchanged
+    heights persist only a pointer; loads follow it and roll proposer
+    priorities forward, so the loaded set matches what a per-height full
+    write would have stored."""
+    import json
+
+    from tendermint_tpu.state.store import _validators_key
+
+    vs = _mk_pointer_valset()
+    ss = StateStore(MemDB())
+    ss._save_validators(4, vs)  # change height: full set
+    for h in range(5, 10):      # unchanged heights: pointer only
+        rolled = vs.copy_increment_proposer_priority(h - 4)
+        ss._save_validators(h, rolled, last_changed=4)
+        raw = json.loads(ss._db.get(_validators_key(h)).decode())
+        assert "set" not in raw and raw["last_changed"] == 4
+
+    for h in range(4, 10):
+        want = vs.copy_increment_proposer_priority(h - 4) if h > 4 else vs
+        got = ss.load_validators(h)
+        assert got is not None
+        assert [v.address for v in got.validators] == \
+            [v.address for v in want.validators]
+        assert [v.proposer_priority for v in got.validators] == \
+            [v.proposer_priority for v in want.validators]
+        assert got.get_proposer().address == want.get_proposer().address
+    # a dangling pointer (pruned target) degrades to None, not a crash
+    ss._db.set(_validators_key(11), json.dumps({"last_changed": 2}).encode())
+    assert ss.load_validators(11) is None
+
+
+def test_pointer_to_pointer_is_materialized():
+    """Rollback can rewrite change heights so a save's natural pointer
+    target is itself a pointer — the save must materialize a full set
+    instead of writing an unresolvable chain (round-5 review finding)."""
+    import json
+
+    from tendermint_tpu.state.store import _validators_key
+
+    vs = _mk_pointer_valset(seed=8)
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)                     # full at 2
+    ss._save_validators(5, vs, last_changed=2)     # pointer 5 -> 2
+    # a later save claims last_changed=5, but 5 is a pointer: materialize
+    rolled = vs.copy_increment_proposer_priority(4)
+    ss._save_validators(6, rolled, last_changed=5)
+    raw = json.loads(ss._db.get(_validators_key(6)).decode())
+    assert "set" in raw
+    got = ss.load_validators(6)
+    assert [v.proposer_priority for v in got.validators] == \
+        [v.proposer_priority for v in rolled.validators]
+
+
+def test_prune_keeps_validator_checkpoint():
+    """Pruning below a pointer's change height must not orphan it: a full
+    checkpoint materializes at the retain height and later pointers clamp
+    to it (store.go lastStoredHeightFor semantics)."""
+    from tendermint_tpu.state.store import _validators_key
+
+    vs = _mk_pointer_valset(n=4, seed=9, base_power=7)
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)  # change height
+    for h in range(3, 12):
+        ss._save_validators(h, vs, last_changed=2)
+
+    expect_at_8 = ss.load_validators(8)
+    ss.prune_states(6)  # drops heights < 6, incl. the full record at 2
+
+    assert ss._db.get(_validators_key(2)) is None
+    # heights >= 6 still resolve, through the checkpoint at 6
+    got = ss.load_validators(8)
+    assert got is not None
+    assert [v.proposer_priority for v in got.validators] == \
+        [v.proposer_priority for v in expect_at_8.validators]
+    assert got.get_proposer().address == expect_at_8.get_proposer().address
