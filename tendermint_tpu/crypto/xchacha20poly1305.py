@@ -1,0 +1,81 @@
+"""XChaCha20-Poly1305 AEAD (reference crypto/xchacha20poly1305/ — the
+legacy key-file AEAD alongside xsalsa20symmetric).
+
+Construction per draft-irtf-cfrg-xchacha: HChaCha20(key, nonce[:16])
+derives a subkey, then standard ChaCha20-Poly1305 (RFC 8439, provided by
+the OpenSSL-backed ``cryptography`` package) runs with the 96-bit nonce
+``4x00 || nonce[16:24]``. Only HChaCha20 is hand-rolled, pinned to the
+draft's §2.2.1 test vector.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+KEY_SIZE = 32
+NONCE_SIZE = 24
+TAG_SIZE = 16
+
+_SIGMA = struct.unpack("<4I", b"expand 32-byte k")
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+
+def _quarter(x, a, b, c, d):
+    x[a] = (x[a] + x[b]) & 0xFFFFFFFF
+    x[d] = _rotl(x[d] ^ x[a], 16)
+    x[c] = (x[c] + x[d]) & 0xFFFFFFFF
+    x[b] = _rotl(x[b] ^ x[c], 12)
+    x[a] = (x[a] + x[b]) & 0xFFFFFFFF
+    x[d] = _rotl(x[d] ^ x[a], 8)
+    x[c] = (x[c] + x[d]) & 0xFFFFFFFF
+    x[b] = _rotl(x[b] ^ x[c], 7)
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """draft-irtf-cfrg-xchacha §2.2: 20 ChaCha rounds, output words
+    0..3 and 12..15 (no feed-forward)."""
+    x = list(_SIGMA) + list(struct.unpack("<8I", key)) \
+        + list(struct.unpack("<4I", nonce16))
+    for _ in range(10):
+        _quarter(x, 0, 4, 8, 12)
+        _quarter(x, 1, 5, 9, 13)
+        _quarter(x, 2, 6, 10, 14)
+        _quarter(x, 3, 7, 11, 15)
+        _quarter(x, 0, 5, 10, 15)
+        _quarter(x, 1, 6, 11, 12)
+        _quarter(x, 2, 7, 8, 13)
+        _quarter(x, 3, 4, 9, 14)
+    return struct.pack("<8I", *(x[i] for i in (0, 1, 2, 3, 12, 13, 14, 15)))
+
+
+def _subparts(key: bytes, nonce: bytes):
+    if len(key) != KEY_SIZE:
+        raise ValueError("xchacha20poly1305 key must be 32 bytes")
+    if len(nonce) != NONCE_SIZE:
+        raise ValueError("xchacha20poly1305 nonce must be 24 bytes")
+    subkey = hchacha20(key, nonce[:16])
+    return subkey, b"\x00" * 4 + nonce[16:]
+
+
+def seal(key: bytes, nonce: bytes, plaintext: bytes,
+         aad: bytes = b"") -> bytes:
+    subkey, n12 = _subparts(key, nonce)
+    return ChaCha20Poly1305(subkey).encrypt(n12, plaintext, aad or None)
+
+
+def open_(key: bytes, nonce: bytes, ciphertext: bytes,
+          aad: bytes = b"") -> Optional[bytes]:
+    """-> plaintext, or None on authentication failure (the Go AEAD's
+    Open-returns-error surface)."""
+    subkey, n12 = _subparts(key, nonce)
+    try:
+        return ChaCha20Poly1305(subkey).decrypt(n12, ciphertext, aad or None)
+    except InvalidTag:
+        return None

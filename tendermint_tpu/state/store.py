@@ -177,6 +177,12 @@ class StateStore:
         if last_changed is None or last_changed >= height:
             last_changed = height
         if height != last_changed:
+            # clamp to the prune checkpoint like load_validators does: the
+            # original change-height record may be pruned, but the pointer
+            # still resolves through the checkpoint's full set
+            ckpt_raw = self._db.get(_VALS_CHECKPOINT_KEY)
+            if ckpt_raw is not None:
+                last_changed = min(height, max(last_changed, int(ckpt_raw)))
             target = self._db.get(_validators_key(last_changed))
             if target is not None and b'"set"' in target:
                 self._db.set(_validators_key(height), json.dumps(

@@ -258,3 +258,30 @@ def test_prune_keeps_validator_checkpoint():
     assert [v.proposer_priority for v in got.validators] == \
         [v.proposer_priority for v in expect_at_8.validators]
     assert got.get_proposer().address == expect_at_8.get_proposer().address
+
+
+def test_saves_after_prune_stay_pointers_via_checkpoint():
+    """After pruning drops a change-height record, later saves clamp their
+    pointer to the checkpoint instead of permanently materializing full
+    sets (round-5 review finding: the per-block encode cost must not come
+    back after the first prune)."""
+    import json
+
+    from tendermint_tpu.state.store import _validators_key
+
+    vs = _mk_pointer_valset(seed=12)
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)
+    for h in range(3, 8):
+        ss._save_validators(h, vs.copy_increment_proposer_priority(h - 2),
+                            last_changed=2)
+    ss.prune_states(6)  # change-height record at 2 is gone; checkpoint at 6
+
+    for h in range(8, 12):
+        rolled = vs.copy_increment_proposer_priority(h - 2)
+        ss._save_validators(h, rolled, last_changed=2)
+        raw = json.loads(ss._db.get(_validators_key(h)).decode())
+        assert "set" not in raw and raw["last_changed"] == 6, raw
+        got = ss.load_validators(h)
+        assert [v.proposer_priority for v in got.validators] == \
+            [v.proposer_priority for v in rolled.validators]
