@@ -501,6 +501,11 @@ def bench_localnet():
              "--starting-port", str(port0)],
             check=True, capture_output=True, timeout=120)
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # CPU-pinned subprocess nodes must not touch the TPU relay: the
+        # axon plugin registers at interpreter startup (sitecustomize) and
+        # a slow relay would stall all four nodes' startup past the
+        # liveness deadline (the e2e runner drops this var the same way)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         for i in range(4):
             procs.append(subprocess.Popen(
                 ["python", "-m", "tendermint_tpu.cmd", "--home",

@@ -73,7 +73,9 @@ def cmd_start(args) -> int:
     # persistent XLA compile cache: the batched-verify kernels take minutes
     # to compile cold; without this every fresh node process pays that on
     # its first device-routed batch (TMTPU_JAX_CACHE overrides, e.g. the
-    # e2e runner points all subprocess nodes at one shared cache)
+    # e2e runner points all subprocess nodes at one shared cache). Must use
+    # the config API, not env: this image's sitecustomize imports jax at
+    # interpreter startup, so import-time env reads have already happened.
     try:
         import jax
 

@@ -182,7 +182,9 @@ class StateStore:
             # still resolves through the checkpoint's full set
             ckpt_raw = self._db.get(_VALS_CHECKPOINT_KEY)
             if ckpt_raw is not None:
-                last_changed = min(height, max(last_changed, int(ckpt_raw)))
+                last_changed = max(last_changed, int(ckpt_raw))
+        if height > last_changed:  # re-checked AFTER the clamp: a pointer
+            # to self would overwrite the checkpoint's materialized set
             target = self._db.get(_validators_key(last_changed))
             if target is not None and b'"set"' in target:
                 self._db.set(_validators_key(height), json.dumps(

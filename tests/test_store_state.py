@@ -285,3 +285,36 @@ def test_saves_after_prune_stay_pointers_via_checkpoint():
         got = ss.load_validators(h)
         assert [v.proposer_priority for v in got.validators] == \
             [v.proposer_priority for v in rolled.validators]
+
+
+def test_resave_at_checkpoint_height_keeps_full_set():
+    """A re-save AT the checkpoint height with a stale change height
+    (rollback/crash-replay) must not clamp into a self-pointer that
+    overwrites the checkpoint's materialized full set (round-5 review
+    finding — reproduced as load_validators returning None forever)."""
+    import json
+
+    from tendermint_tpu.state.store import _validators_key
+
+    vs = _mk_pointer_valset(seed=21)
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)
+    for h in range(3, 8):
+        ss._save_validators(h, vs.copy_increment_proposer_priority(h - 2),
+                            last_changed=2)
+    ss.prune_states(6)
+
+    # replay re-saves height 6 claiming the pruned change height
+    rolled6 = vs.copy_increment_proposer_priority(4)
+    ss._save_validators(6, rolled6, last_changed=2)
+    raw = json.loads(ss._db.get(_validators_key(6)).decode())
+    assert "set" in raw, "checkpoint full set must survive the re-save"
+    for h in (6, 7):
+        got = ss.load_validators(h)
+        assert got is not None
+    # and a save BELOW the checkpoint (rollback past it) materializes
+    ss._save_validators(5, vs.copy_increment_proposer_priority(3),
+                        last_changed=2)
+    raw5 = json.loads(ss._db.get(_validators_key(5)).decode())
+    assert "set" in raw5
+    assert ss.load_validators(5) is not None
