@@ -23,8 +23,21 @@ def _validators_key(h: int) -> bytes:
     return b"validatorsKey:" + str(h).encode()
 
 
-# resolution floor for validator change-pointers after pruning
+# resolution floor for validator change-pointers after pruning (heights
+# below it are deleted) — distinct from the materialization marker below,
+# which only says "a nearby full record exists", never "data is gone"
 _VALS_CHECKPOINT_KEY = b"validatorsCheckpoint"
+# latest interval-materialized full record (see _VALS_MATERIALIZE_INTERVAL)
+_VALS_MATERIALIZED_KEY = b"validatorsMaterialized"
+
+# materialize a full set at least this often even without changes: loads
+# roll proposer priorities forward from the pointer target, so unbounded
+# pointer runs make load_validators O(height since change) — the reference
+# bounds the same walk with valSetCheckpointInterval (store.go:36; its
+# 100k interval tolerates huge rolls because Go's increment is ~ns — in
+# Python a short interval keeps the per-load roll under ~16 increments
+# while a full write every 16 heights amortizes to noise)
+_VALS_MATERIALIZE_INTERVAL = 16
 
 
 def _params_key(h: int) -> bytes:
@@ -176,24 +189,40 @@ class StateStore:
         and a pointer chain would make the height unloadable."""
         if last_changed is None or last_changed >= height:
             last_changed = height
-        if height != last_changed:
-            # clamp to the prune checkpoint like load_validators does: the
-            # original change-height record may be pruned, but the pointer
-            # still resolves through the checkpoint's full set
-            ckpt_raw = self._db.get(_VALS_CHECKPOINT_KEY)
-            if ckpt_raw is not None:
-                last_changed = max(last_changed, int(ckpt_raw))
-        if height > last_changed:  # re-checked AFTER the clamp: a pointer
-            # to self would overwrite the checkpoint's materialized set
-            target = self._db.get(_validators_key(last_changed))
+        target_h = (self._resolve_target(last_changed, height)
+                    if height > last_changed else height)
+        if (height > target_h
+                and height - target_h < _VALS_MATERIALIZE_INTERVAL):
+            target = self._db.get(_validators_key(target_h))
             if target is not None and b'"set"' in target:
                 self._db.set(_validators_key(height), json.dumps(
-                    {"last_changed": last_changed}).encode())
+                    {"last_changed": target_h}).encode())
                 return
             # unresolvable target: materialize (self-healing, no chains)
         self._db.set(_validators_key(height), json.dumps({
             "last_changed": height, "set": vals.encode().hex(),
         }).encode())
+        if height > last_changed:
+            # interval materialization: record this nearby full set so
+            # subsequent pointers (and loads) target it instead of rolling
+            # priorities all the way from the original change height
+            self._db.set(_VALS_MATERIALIZED_KEY, str(height).encode())
+
+    def _resolve_target(self, last_changed: int, height: int) -> int:
+        """The best full-record height for a pointer valid at ``height``:
+        the highest of the declared change height, the prune floor, and the
+        latest materialized record that does not exceed ``height`` (a
+        prune floor above ``height`` means the data is simply gone; a
+        materialization above it must be ignored, records below it still
+        exist)."""
+        best = last_changed
+        raw = self._db.get(_VALS_CHECKPOINT_KEY)
+        if raw is not None and best < int(raw) <= height:
+            best = int(raw)
+        raw = self._db.get(_VALS_MATERIALIZED_KEY)
+        if raw is not None and best < int(raw) <= height:
+            best = int(raw)
+        return best
 
     def load_validators(self, height: int) -> Optional[ValidatorSet]:
         """(loadValidators, store.go:249) follow the change pointer, then
@@ -204,14 +233,7 @@ class StateStore:
         d = json.loads(raw.decode())
         if "set" in d:
             return ValidatorSet.decode(bytes.fromhex(d["set"]))
-        last_changed = int(d["last_changed"])
-        # pruning may have dropped the original change-height record; the
-        # checkpoint written by prune_states is the resolution floor
-        ckpt_raw = self._db.get(_VALS_CHECKPOINT_KEY)
-        if ckpt_raw is not None:
-            last_changed = max(last_changed, int(ckpt_raw))
-        if last_changed > height:
-            return None
+        last_changed = self._resolve_target(int(d["last_changed"]), height)
         raw2 = self._db.get(_validators_key(last_changed))
         if raw2 is None:
             return None

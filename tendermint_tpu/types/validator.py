@@ -36,7 +36,15 @@ def safe_mul(a: int, b: int) -> "tuple[int, bool]":
 
 
 def pubkey_proto_bytes(pub: crypto.PubKey) -> bytes:
-    """tendermint.crypto.PublicKey oneof encoding (proto/tendermint/crypto/keys.proto)."""
+    """tendermint.crypto.PublicKey oneof encoding (proto/tendermint/crypto/keys.proto).
+
+    Cached on the key instance: PubKey objects are immutable and shared
+    across Validator copies (Validator.copy passes the reference), while
+    state persistence and valset hashing re-encode every validator several
+    times per block — profiling showed this as the hottest proto call."""
+    cached = getattr(pub, "_proto_bytes", None)
+    if cached is not None:
+        return cached
     w = pw.Writer()
     if pub.type_name == crypto.ED25519_TYPE:
         w.bytes(1, pub.bytes())
@@ -44,7 +52,14 @@ def pubkey_proto_bytes(pub: crypto.PubKey) -> bytes:
         w.bytes(2, pub.bytes())
     else:
         raise ValueError(f"unsupported pubkey type {pub.type_name!r}")
-    return w.finish()
+    out = w.finish()
+    try:
+        # frozen-dataclass keys need the object.__setattr__ side door;
+        # equality/hash use declared fields only, so the cache is invisible
+        object.__setattr__(pub, "_proto_bytes", out)
+    except AttributeError:
+        pass  # __slots__ keys just skip the cache
+    return out
 
 
 def pubkey_from_proto(data: bytes) -> crypto.PubKey:
@@ -86,13 +101,26 @@ class Validator:
         return w.finish()
 
     def encode(self) -> bytes:
-        """Full Validator proto (validator.proto:15-20) for wire/storage."""
-        w = pw.Writer()
-        w.bytes(1, self.address)
-        w.message(2, pubkey_proto_bytes(self.pub_key))
-        w.varint(3, self.voting_power)
-        w.varint(4, self.proposer_priority)
-        return w.finish()
+        """Full Validator proto (validator.proto:15-20) for wire/storage.
+
+        The address/pubkey/power prefix is immutable for a validator's
+        lifetime and cached; only the proposer-priority varint (which
+        rotates every height) is re-encoded. State persistence encodes
+        whole 1000-validator sets several times per block, so this is a
+        measured hot path, not speculation."""
+        key = (id(self.pub_key), self.voting_power)
+        cached = self.__dict__.get("_enc_prefix")
+        if cached is None or cached[0] != key:
+            w = pw.Writer()
+            w.bytes(1, self.address)
+            w.message(2, pubkey_proto_bytes(self.pub_key))
+            w.varint(3, self.voting_power)
+            cached = (key, w.finish())
+            self.__dict__["_enc_prefix"] = cached
+        pp = self.proposer_priority
+        if pp == 0:  # proto3 zero omission, like Writer.varint
+            return cached[1]
+        return cached[1] + pw.tag(4, pw.WIRE_VARINT) + pw.encode_varint(pp)
 
     @staticmethod
     def decode(data: bytes) -> "Validator":

@@ -318,3 +318,33 @@ def test_resave_at_checkpoint_height_keeps_full_set():
     raw5 = json.loads(ss._db.get(_validators_key(5)).decode())
     assert "set" in raw5
     assert ss.load_validators(5) is not None
+
+
+def test_materialization_does_not_mask_prune_floor():
+    """Round-5 review repro: change@84, pointers 85+, prune@95, then
+    interval materialization advances past a retained height — loads for
+    retained heights must keep resolving through the prune floor's full
+    record (the materialization marker must never imply data loss)."""
+    from tendermint_tpu.state import store as st
+
+    vs = _mk_pointer_valset(seed=33)
+    ss = StateStore(MemDB())
+    ss._save_validators(84, vs)
+    for h in range(85, 100):
+        ss._save_validators(h, vs.copy_increment_proposer_priority(h - 84),
+                            last_changed=84)
+    ss.prune_states(95)
+    # keep saving; force an interval materialization past height 97
+    for h in range(100, 100 + st._VALS_MATERIALIZE_INTERVAL + 2):
+        ss._save_validators(h, vs.copy_increment_proposer_priority(h - 84),
+                            last_changed=84)
+    assert ss._db.get(st._VALS_MATERIALIZED_KEY) is not None
+    # retained heights below the materialization point still load
+    for h in (95, 97, 99, 100):
+        got = ss.load_validators(h)
+        assert got is not None, f"height {h} unloadable"
+        want = vs.copy_increment_proposer_priority(h - 84)
+        assert [v.proposer_priority for v in got.validators] == \
+            [v.proposer_priority for v in want.validators]
+    # and pruned heights are honestly gone
+    assert ss.load_validators(90) is None
