@@ -616,7 +616,9 @@ def bench_verify_commit_10k():
             verify_window(per_commit[i:i + window])
 
     sustained()  # compile + warm the pk device cache
-    best = _timed(sustained, warm=0, runs=3)
+    # min-of-5: the relay's effective bandwidth swings 2-4x hour to hour
+    # and several-second dips are common even within a good phase
+    best = _timed(sustained, warm=0, runs=5)
     total_sigs = n_commits * n_vals
     dev_rate = total_sigs / best
 
