@@ -169,14 +169,14 @@ def bench_verify_commit_150():
     (reference types/validator_set.go:667) — the live consensus hot loop.
 
     Two regimes:
-    * remote-relay (this bench host): a single interactive commit pays the
-      full ~100 ms dispatch latency, so the auto backend keeps it on host;
-      the metric proves the routing seam costs nothing vs the pinned host
-      backend (interleaved A/B to cancel CPU drift);
-    * locally-attached silicon: TMTPU_DEVICE_THRESHOLD=16 emulates the
-      measured on-chip break-even (crypto/batch.py:31), routing the 150-sig
-      commit to the device — the second metric records what the hot loop
-      does when the TPU is not behind a relay.
+    * seam cost: the auto backend vs the pinned host backend, interleaved
+      A/B to cancel CPU drift — proves the routing seam costs nothing;
+    * routing honesty: the auto router measured as-is. The calibrated
+      break-even (crypto/batch.py device_threshold, payload-bearing probe)
+      must keep a sub-threshold commit on the host path, so the routed
+      number may never be slower than scalar — asserted, not just
+      reported. (BENCH_r05 regression: a forced 16-sig threshold pushed
+      this commit through the relay at 0.18x scalar.)
     """
     vs, keys = _mk_val_set(150)
     commit, bid = _sign_commit(vs, keys, 100, "bench-150")
@@ -206,13 +206,24 @@ def bench_verify_commit_150():
     _emit("verify_commit_150_vals_sigs_per_sec", 150 / dev, "sigs/s",
           host / dev)
 
-    os.environ["TMTPU_DEVICE_THRESHOLD"] = "16"
-    try:
-        dev_local = _timed(run, warm=1, runs=3)
-    finally:
-        del os.environ["TMTPU_DEVICE_THRESHOLD"]
+    # routed regime: the interleaved auto-backend measurement above IS the
+    # calibrated router's decision (150 sigs below the break-even stays on
+    # host; on locally-attached silicon, threshold ~16, the same call
+    # routes to the device and must win there). Reusing the drift-cancelled
+    # A/B numbers keeps the never-slower assertion symmetric — no separate
+    # un-interleaved timing, no fudge factor.
+    from tendermint_tpu.crypto.batch import device_threshold
+
+    thr = device_threshold()
+    not_slower = dev <= host * 1.05  # interleaved min-of-9 each; 5% jitter
     _emit("verify_commit_150_vals_device_routed_sigs_per_sec",
-          150 / dev_local, "sigs/s", host / dev_local)
+          150 / dev, "sigs/s", host / dev,
+          calibrated_threshold=thr,
+          routed_backend="jax" if 150 >= thr else "host",
+          routing_not_slower_than_scalar=bool(not_slower))
+    assert not_slower, (
+        f"device routing slower than scalar: routed {150 / dev:.0f} "
+        f"sigs/s vs host {150 / host:.0f} sigs/s (threshold {thr})")
 
 
 def bench_light_chain_1000():
@@ -434,19 +445,27 @@ def bench_fast_sync_pipeline():
     def replay(n):
         state, execu, block_store, conns = fresh_node()
         try:
-            for b in blocks:  # fresh node: no memoized sign-bytes
+            for b in blocks:  # fresh node: none of the per-instance memos a
+                # previous replay populated (sign-bytes, part sets, header
+                # hashes) may leak into this pass — the host baseline must
+                # pay the same hashing work the timed run paid
                 b.last_commit.__dict__.pop("_sb_cache", None)
+                b.__dict__.pop("_part_set_cache", None)
+                b.header.__dict__.pop("_hash_memo", None)
             reactor = BlockchainReactor(state, execu, block_store,
                                         fast_sync=True)
             reactor.pool = BlockPool(1)
             reactor.pool.set_peer_range("src", 1, n + 1)
 
             async def drive():
-                # fill a FULL verify window before each process call so the
-                # batched device shapes stay constant (n is a multiple of the
+                # keep TWO full verify windows downloaded before each
+                # process call: the apply pipeline prepares window N+1 on a
+                # worker thread while window N applies, and needs N+1's
+                # blocks present at spawn time (n is a multiple of the
                 # reactor's VERIFY_WINDOW=16, so no ragged tail window)
                 while reactor.blocks_synced < n:
-                    while len(reactor.pool.peek_window(17)) < 17:
+                    want = min(33, n + 2 - reactor.pool.height)
+                    while len(reactor.pool.peek_window(33)) < want:
                         reqs = reactor.pool.schedule_requests()
                         if not reqs:
                             break
@@ -460,12 +479,13 @@ def bench_fast_sync_pipeline():
 
             asyncio.run(drive())
             assert block_store.height() >= n
+            return reactor
         finally:
             conns.stop()
 
     replay(32)  # warm: compile shapes, device pk cache
     t0 = time.perf_counter()
-    replay(n_blocks)
+    reactor = replay(n_blocks)
     dev = time.perf_counter() - t0
     os.environ["TMTPU_BATCH_BACKEND"] = "host"
     try:
@@ -475,6 +495,22 @@ def bench_fast_sync_pipeline():
     finally:
         del os.environ["TMTPU_BATCH_BACKEND"]
     rate = n_blocks / dev
+    st = reactor.stage_times
+    assert st["pipelined_windows"] > 0, \
+        "apply pipeline never engaged: every window was prepared inline"
+    # hash+store share of end-to-end pipeline wall-clock: the two apply-plane
+    # costs this round attacked directly (iterative merkle + hash
+    # memoization; per-window write batches). verify_s runs on the worker
+    # thread overlapped with apply, so stage shares can sum past 1.0.
+    _emit("fast_sync_pipeline_breakdown_hash_store_share",
+          (st["hash_s"] + st["store_s"]) / dev, "ratio", 0.0,
+          hash_seconds=round(st["hash_s"], 3),
+          store_seconds=round(st["store_s"], 3),
+          verify_seconds=round(st["verify_s"], 3),
+          abci_seconds=round(st["abci_s"], 3),
+          wall_seconds=round(dev, 3),
+          pipelined_windows=st["pipelined_windows"],
+          inline_windows=st["inline_windows"])
     _emit("fast_sync_1000_vals_pipeline_blocks_per_sec", rate, "blocks/s",
           rate / host_rate)
 
@@ -592,17 +628,22 @@ def bench_verify_commit_10k():
     from tendermint_tpu.crypto.ed25519_jax import verify as V
 
     n_vals, n_commits, window = 10240, 12, 12
+    repeats = 5
     vs, keys = _mk_val_set(n_vals)
     chain = "bench-10k"
-    commits = [_sign_commit(vs, keys, h, chain)[0]
-               for h in range(1, n_commits + 1)]
-    # flatten (pk, msg, sig) in valset order, per commit
-    per_commit = []
-    for c in commits:
-        pks = [v.pub_key.bytes() for v in vs.validators]
-        msgs = [c.vote_sign_bytes(chain, i) for i in range(n_vals)]
-        sigs = [cs.signature for cs in c.signatures]
-        per_commit.append((pks, msgs, sigs))
+    pks_row = [v.pub_key.bytes() for v in vs.validators]
+
+    def build_slice(base_h):
+        """A fresh n_commits batch signed at disjoint heights: every repeat
+        gets distinct sign-bytes AND signatures, so the relay's
+        identical-computation cache cannot serve a previous repeat's run
+        and inflate the min-of-N."""
+        per_commit = []
+        for h in range(base_h, base_h + n_commits):
+            c = _sign_commit(vs, keys, h, chain)[0]
+            per_commit.append((pks_row, c.vote_sign_bytes_all(chain),
+                               [cs.signature for cs in c.signatures]))
+        return per_commit
 
     def verify_window(cs):
         pks = [p for c in cs for p in c[0]]
@@ -611,39 +652,54 @@ def bench_verify_commit_10k():
         out = V.batch_verify_stream(pks, msgs, sigs, chunk=CHUNK)
         assert out.all()
 
-    def sustained():
+    def sustained(per_commit):
         for i in range(0, n_commits, window):
             verify_window(per_commit[i:i + window])
 
-    sustained()  # compile + warm the pk device cache
-    # min-of-5: the relay's effective bandwidth swings 2-4x hour to hour
-    # and several-second dips are common even within a good phase
-    best = _timed(sustained, warm=0, runs=5)
+    warm_pc = build_slice(1)
+    sustained(warm_pc)  # compile + warm the pk device cache
+    # min-of-5 with FRESH inputs per repeat: the relay's effective bandwidth
+    # swings 2-4x hour to hour, but its cache must never turn a repeat into
+    # a no-op; per-repeat values land in the JSON for auditability
+    repeat_times = []
+    for rep in range(repeats):
+        pc = build_slice(1000 + rep * n_commits)  # untimed setup
+        t0 = time.perf_counter()
+        sustained(pc)
+        repeat_times.append(time.perf_counter() - t0)
+        del pc
+    best = min(repeat_times)
     total_sigs = n_commits * n_vals
     dev_rate = total_sigs / best
 
     # host scalar baseline on a subset
-    pubs = [crypto.Ed25519PubKey(p) for p in per_commit[0][0][:N_BASE]]
-    host_rate = _host_rate(pubs, per_commit[0][1], per_commit[0][2], N_BASE)
+    pubs = [crypto.Ed25519PubKey(p) for p in warm_pc[0][0][:N_BASE]]
+    host_rate = _host_rate(pubs, warm_pc[0][1], warm_pc[0][2], N_BASE)
 
     # stage breakdown for the sustained path: host packing per pipeline
     # segment (2 commits = 10 chunks each, the segmented path's unit)
     t0 = time.perf_counter()
     for i in range(0, n_commits, 2):
-        cs = per_commit[i:i + 2]
+        cs = warm_pc[i:i + 2]
         V.prepare_sparse_stream([p for c in cs for p in c[0]],
                                 [m for c in cs for m in c[1]],
                                 [s for c in cs for s in c[2]], CHUNK)
     pack_s = time.perf_counter() - t0
 
-    # one-shot: single commit, one call
-    one = _timed(lambda: verify_window(per_commit[:1]), warm=1, runs=3)
+    # one-shot: single cold commit, one call — three DISTINCT commits so
+    # the relay cache can't serve run 2 and 3 from run 1
+    oneshot_pc = build_slice(5000)[:3]
+    one = min(_timed(lambda c=c: verify_window([c]), warm=0, runs=1)
+              for c in oneshot_pc)
     _emit("verify_commit_10k_oneshot_sigs_per_sec", n_vals / one, "sigs/s",
           (n_vals / one) / host_rate)
     _emit("verify_commit_10k_breakdown_pack_share", pack_s / best, "ratio",
           0.0, pack_seconds=round(pack_s, 3), total_seconds=round(best, 3))
     _emit("verify_commit_10k_sigs_per_sec", dev_rate, "sigs/s",
-          dev_rate / host_rate)
+          dev_rate / host_rate,
+          per_repeat_seconds=[round(t, 3) for t in repeat_times],
+          per_repeat_sigs_per_sec=[round(total_sigs / t, 1)
+                                   for t in repeat_times])
 
 
 CONFIGS = {

@@ -157,8 +157,14 @@ class BlockPool:
 
     def peek_window(self, max_blocks: int) -> List[Tuple[Block, str]]:
         """Contiguous (block, provider peer) run starting at self.height."""
+        return self.peek_from(self.height, max_blocks)
+
+    def peek_from(self, start_height: int, max_blocks: int) -> List[Tuple[Block, str]]:
+        """Contiguous (block, provider peer) run starting at an arbitrary
+        height ≥ self.height — the apply pipeline peeks the NEXT window's
+        blocks while the current one is still applying."""
         out: List[Tuple[Block, str]] = []
-        h = self.height
+        h = start_height
         while len(out) < max_blocks:
             req = self._requests.get(h)
             if req is None or req.block is None:

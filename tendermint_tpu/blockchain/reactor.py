@@ -10,6 +10,23 @@ a validator set (header.validators_hash equality — the hash commits to the
 full set), which is the common case; heights where the set changes fall back
 to per-block verification. This is baseline config #5 (10k-block replay at
 1000 validators).
+
+The apply plane is a 2-deep stage pipeline:
+
+    stage A (worker thread)   | window N:  hash blocks (part sets, block
+                              | IDs), precompute both signature planes,
+                              | batched light-verify
+    stage B (event loop)      | window N-1: ABCI exec + per-window batched
+                              | store writes
+
+While window N-1 is in stage B, window N's stage A runs concurrently on the
+executor (device dispatch and OpenSSL release the GIL, so the verify
+round-trip hides under ABCI exec). The single ``_prepared`` slot is the
+explicit backpressure bound: at most one window of lookahead, prepared
+results are consumed in strict height order, and a prepared window is
+discarded whenever the pool or validator set moved underneath it (redo,
+valset change), so apply order and peer-punish semantics are identical to
+the unpipelined loop.
 """
 
 from __future__ import annotations
@@ -17,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..p2p import BLOCKCHAIN_CHANNEL
@@ -59,6 +77,19 @@ STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 
 
+@dataclass
+class _PreparedWindow:
+    """Stage-A output for one verify window, handed to the apply stage."""
+
+    start_height: int
+    vals_hash: bytes          # validator-set hash the window was gated on
+    window: list              # [(block, peer_id)] — the pairs + commit carrier
+    pairs: list               # [(blk, peer_id, next_blk, next_peer_id)]
+    entries: list             # verify_commit_light_batched inputs
+    results: list             # per-entry verdicts (None or exception)
+    pre: Optional[dict] = field(default=None, repr=False)  # verdict memo
+
+
 class BlockchainReactor(Reactor):
     def __init__(self, state: State, block_exec: BlockExecutor,
                  block_store: BlockStore, fast_sync: bool,
@@ -77,6 +108,13 @@ class BlockchainReactor(Reactor):
         self.on_fatal = on_fatal
         self.synced = asyncio.Event()  # set on switch-to-consensus
         self.blocks_synced = 0
+        # the pipeline's single lookahead slot (backpressure bound = 1)
+        self._prepared: Optional[_PreparedWindow] = None
+        # cumulative stage wall-clock, exported by bench.py as the pipeline
+        # breakdown (hash+store share of end-to-end sync time)
+        self.stage_times = {"hash_s": 0.0, "verify_s": 0.0, "store_s": 0.0,
+                            "abci_s": 0.0, "pipelined_windows": 0,
+                            "inline_windows": 0}
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=5,
@@ -100,6 +138,7 @@ class BlockchainReactor(Reactor):
         self.state = state
         self.fast_sync = True
         self.synced.clear()
+        self._prepared = None  # any lookahead was for the old pool
         self.pool = BlockPool(state.last_block_height + 1)
         if self._pool_task is None:
             self._pool_task = asyncio.create_task(self._pool_routine())
@@ -204,37 +243,126 @@ class BlockchainReactor(Reactor):
             self.consensus_reactor.switch_to_consensus(self.state)
 
     async def _process_window(self) -> None:
-        """Verify+apply a contiguous run of downloaded blocks.
+        """Verify+apply a contiguous run of downloaded blocks, pipelined.
 
         Block N's canonical commit is block N+1's LastCommit, so a run of
         k+1 blocks yields k verifiable (block, commit) pairs. All pairs whose
         headers commit to the CURRENT validator set are verified as one
         device batch; the rest of the run waits for the state to advance.
+
+        Steady state: the window was already verified by the previous
+        iteration's prepare-ahead (stage A ran while the previous window
+        applied); this iteration applies it and concurrently prepares the
+        next one.
         """
-        window = self.pool.peek_window(VERIFY_WINDOW + 1)
-        if len(window) < 2:
-            return
-        cur_vals_hash = self.state.validators.hash()
+        loop = asyncio.get_running_loop()
+        prep = self._take_prepared()
+        if prep is None:
+            window = self.pool.peek_window(VERIFY_WINDOW + 1)
+            if len(window) < 2:
+                return
+            cur_vals_hash = self.state.validators.hash()
+            pairs = self._select_pairs(window, cur_vals_hash)
+            if not pairs:
+                # the very next block claims a different valset: its commit
+                # can't be checked against our state -> bad block
+                # (validate_block would reject it anyway); redo from here.
+                first, first_peer = window[0]
+                await self._punish(self.pool.redo(first.header.height),
+                                   "block valset hash mismatch")
+                return
+            # off-loop: a cold backend compile or a big host batch inside
+            # the loop would stall RPC/p2p liveness for the whole node
+            prep = await loop.run_in_executor(
+                None, self._stage_a, window, pairs, cur_vals_hash,
+                self.state.last_validators, self.state.validators,
+                self.state.chain_id)
+            self.stage_times["inline_windows"] += 1
+        else:
+            self.stage_times["pipelined_windows"] += 1
+
+        # 2-deep pipeline: kick off stage A for the NEXT window on a worker
+        # thread before this window's apply starts. Snapshot the pre-apply
+        # valset NOW — the prepared result is only consumed if the apply
+        # leaves the set's membership unchanged (_take_prepared re-checks).
+        next_task = None
+        next_start = prep.start_height + len(prep.pairs)
+        nwindow = self.pool.peek_from(next_start, VERIFY_WINDOW + 1)
+        if len(nwindow) >= 2:
+            npairs = self._select_pairs(nwindow, prep.vals_hash)
+            if npairs:
+                # prepared-ahead windows verify every block against the
+                # CURRENT set: the run is gated on hash equality, so the
+                # first block's signing set (its previous height's valset)
+                # has identical membership and powers
+                next_task = loop.run_in_executor(
+                    None, self._stage_a, nwindow, npairs, prep.vals_hash,
+                    self.state.validators, self.state.validators,
+                    self.state.chain_id)
+        try:
+            await self._apply_window(prep)
+        except BaseException:
+            # a failed window N aborts N+1 cleanly: nothing from the
+            # lookahead may outlive the fault
+            if next_task is not None:
+                next_task.cancel()
+            self._prepared = None
+            raise
+        if next_task is not None:
+            try:
+                self._prepared = await next_task
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("prepare-ahead failed; next window will "
+                                 "re-verify inline")
+                self._prepared = None
+
+    def _take_prepared(self) -> Optional[_PreparedWindow]:
+        """Consume the lookahead slot — only if the world it was computed
+        against still holds: same next height, same validator-set hash, and
+        the pool still holds the very same block objects (a redo swaps in
+        re-downloads from other peers)."""
+        prep, self._prepared = self._prepared, None
+        if prep is None:
+            return None
+        if (prep.start_height != self.pool.height
+                or prep.vals_hash != self.state.validators.hash()):
+            return None
+        window = self.pool.peek_from(prep.start_height, len(prep.window))
+        if len(window) < len(prep.window):
+            return None
+        for (blk, peer_id), (pblk, ppeer_id) in zip(window, prep.window):
+            if blk is not pblk or peer_id != ppeer_id:
+                return None
+        return prep
+
+    @staticmethod
+    def _select_pairs(window, cur_vals_hash) -> List[Tuple[Block, str, Block, str]]:
         pairs: List[Tuple[Block, str, Block, str]] = []  # (blk, peer, next, npeer)
         for (blk, peer_id), (nxt, npeer_id) in zip(window, window[1:]):
             if blk.header.validators_hash != cur_vals_hash:
                 break  # validator set changes mid-window: verify after advance
             pairs.append((blk, peer_id, nxt, npeer_id))
-        if not pairs:
-            # the very next block claims a different valset: its commit can't
-            # be checked against our state -> bad block (validate_block would
-            # reject it anyway); redo from this height.
-            first, first_peer = window[0]
-            await self._punish(self.pool.redo(first.header.height),
-                               "block valset hash mismatch")
-            return
+        return pairs
 
+    # -- stage A: hash + verify (worker thread) -----------------------------
+
+    def _stage_a(self, window, pairs, vals_hash, first_vals, vals,
+                 chain_id) -> _PreparedWindow:
+        """Everything that can run before the window's first ABCI call:
+        part-set construction, block hashing, sign-bytes assembly, the
+        dual-plane signature precompute, and the batched light verify. All
+        results memoize on the immutable block/commit instances, so the
+        apply stage re-derives none of it."""
+        t0 = time.perf_counter()
         entries = []
         for blk, _p, nxt, _np in pairs:
             parts_header = blk.make_part_set().header()
             block_id = BlockID(blk.hash(), parts_header)
-            entries.append((self.state.validators, self.state.chain_id,
-                            block_id, blk.header.height, nxt.last_commit))
+            entries.append((vals, chain_id, block_id, blk.header.height,
+                            nxt.last_commit))
+        t1 = time.perf_counter()
 
         # Pre-verify the window's OTHER signature plane in the same scope:
         # apply_block -> validate_block re-checks each block's LastCommit
@@ -243,27 +371,32 @@ class BlockchainReactor(Reactor):
         # that is a full-dispatch-latency device call per block; batched
         # here, the apply loop's verify_commit hits precomputed verdicts and
         # the whole window costs one device round-trip for BOTH planes.
-        # off-loop: a cold backend compile or a big host batch inside the
-        # loop would stall RPC/p2p liveness for the whole node
-        pre = await asyncio.get_running_loop().run_in_executor(
-            None, self._precompute_last_commit_verdicts, pairs)
+        pre = self._precompute_last_commit_verdicts(pairs, first_vals, vals,
+                                                    chain_id)
         token = precomputed_verdicts.set(pre) if pre is not None else None
         try:
             results = verify_commit_light_batched(entries)
-            await self._apply_window(pairs, results, entries)
         finally:
             if token is not None:
                 precomputed_verdicts.reset(token)
+        t2 = time.perf_counter()
+        self.stage_times["hash_s"] += t1 - t0
+        self.stage_times["verify_s"] += t2 - t1
+        return _PreparedWindow(
+            start_height=pairs[0][0].header.height, vals_hash=vals_hash,
+            window=window[:len(pairs) + 1], pairs=pairs, entries=entries,
+            results=results, pre=pre)
 
-    def _precompute_last_commit_verdicts(self, pairs) -> "Optional[dict]":
+    def _precompute_last_commit_verdicts(self, pairs, first_vals, vals,
+                                         chain_id) -> "Optional[dict]":
         """(pk, sign_bytes, sig) -> verdict for every candidate signature the
         window will verify — the light entries above AND each block's
         LastCommit full-commit candidates. Returns None when the window's
         LastCommits span a validator-set change (the per-block fallback is
-        correct there; _process_window already bounds pairs to one set for
+        correct there; _select_pairs already bounds pairs to one set for
         the light plane)."""
         try:
-            return self._precompute_inner(pairs)
+            return self._precompute_inner(pairs, first_vals, vals, chain_id)
         except Exception as e:
             # peer data is untrusted here (nothing has validated these
             # blocks yet): ANY malformed shape — last_commit=None, odd sig
@@ -273,7 +406,8 @@ class BlockchainReactor(Reactor):
             logger.debug("window precompute skipped: %s", e)
             return None
 
-    def _precompute_inner(self, pairs) -> "Optional[dict]":
+    def _precompute_inner(self, pairs, first_vals, vals,
+                          chain_id) -> "Optional[dict]":
         first_h = pairs[0][0].header.height
         # small-net windows (few validators or a short tail) stay on the
         # per-block path: doubling a tiny batch buys nothing and must not
@@ -292,18 +426,20 @@ class BlockchainReactor(Reactor):
 
         for blk, _p, nxt, _np in pairs:
             # block h's LastCommit was signed by the valset of h-1: the first
-            # window block checks against state.last_validators, later ones
-            # against the (stable) current set
-            vals = (self.state.last_validators if blk.header.height == first_h
-                    else self.state.validators)
+            # window block checks against the caller's first_vals (the live
+            # last_validators when preparing inline; the current set when
+            # preparing ahead, where the hash gate makes them equal), later
+            # ones against the (stable) current set. A stale guess here can
+            # only miss the memo and re-dispatch — never mis-verify.
+            fv = first_vals if blk.header.height == first_h else vals
             lc = blk.last_commit
             if lc is not None and len(lc.signatures):
-                if len(lc.signatures) != vals.size():
+                if len(lc.signatures) != fv.size():
                     return None  # shape mismatch: let validate_block decide
-                sb = lc.vote_sign_bytes_all(self.state.chain_id)
+                sb = lc.vote_sign_bytes_all(chain_id)
                 for idx, cs in enumerate(lc.signatures):
                     if not cs.absent():
-                        _add(vals.validators[idx].pub_key, sb[idx],
+                        _add(fv.validators[idx].pub_key, sb[idx],
                              cs.signature)
             # the light plane of THIS window (nxt.last_commit rows) shares
             # the batch: one device call covers both planes. Candidate rule
@@ -311,39 +447,66 @@ class BlockchainReactor(Reactor):
             # for_block sigs keyed by (pk, vote_sign_bytes_all row, sig) —
             # any divergence makes BatchVerifier miss the precomputed dict
             # and silently re-dispatch, not mis-verify (all-or-nothing hit)
-            cur = self.state.validators
-            sbn = nxt.last_commit.vote_sign_bytes_all(self.state.chain_id)
+            sbn = nxt.last_commit.vote_sign_bytes_all(chain_id)
             for idx, cs in enumerate(nxt.last_commit.signatures):
-                if cs.for_block() and idx < cur.size():
-                    _add(cur.validators[idx].pub_key, sbn[idx], cs.signature)
+                if cs.for_block() and idx < vals.size():
+                    _add(vals.validators[idx].pub_key, sbn[idx], cs.signature)
         if not keys:
             return None
         _, verdicts = bv.verify()
         return {t: bool(v) for t, v in zip(keys, verdicts)}
 
-    async def _apply_window(self, pairs, results, entries) -> None:
-        for (blk, peer_id, nxt, npeer_id), err, entry in zip(
-                pairs, results, entries):
-            if err is not None:
-                logger.warning("invalid block/commit at height %d: %s",
-                               blk.header.height, err)
-                bad = self.pool.redo(blk.header.height)
-                bad.update({peer_id, npeer_id})
-                await self._punish(bad, f"bad block at {blk.header.height}: {err}")
-                return
-            _vs, _chain, block_id, _h, _commit = entry
-            parts = blk.make_part_set()
-            self.store.save_block(blk, parts, nxt.last_commit)
-            # a commit-verified block that fails to apply is a deterministic
-            # local fault (bad app or corrupt state), not a peer fault
-            try:
-                self.state, _retain = self.block_exec.apply_block(
-                    self.state, block_id, blk)
-            except Exception as e:
-                raise FatalSyncError(
-                    f"apply_block failed at {blk.header.height}: {e}") from e
-            self.pool.pop()
-            self.blocks_synced += 1
+    # -- stage B: apply (event loop, strict height order) -------------------
+
+    async def _apply_window(self, prep: _PreparedWindow) -> None:
+        token = (precomputed_verdicts.set(prep.pre)
+                 if prep.pre is not None else None)
+        st = self.stage_times
+        t_flush = None
+        try:
+            # every write the window produces — block parts, commits, seen
+            # commits, ABCI responses, per-height validator/param records,
+            # the state record — lands in ONE write-batch per store, flushed
+            # at scope exit (also on error: staged writes describe blocks
+            # whose ABCI commit already happened)
+            with self.store.window_batch(), \
+                    self.block_exec.state_store.window_batch():
+                for (blk, peer_id, nxt, npeer_id), err, entry in zip(
+                        prep.pairs, prep.results, prep.entries):
+                    if err is not None:
+                        logger.warning("invalid block/commit at height %d: %s",
+                                       blk.header.height, err)
+                        bad = self.pool.redo(blk.header.height)
+                        bad.update({peer_id, npeer_id})
+                        await self._punish(
+                            bad, f"bad block at {blk.header.height}: {err}")
+                        return
+                    _vs, _chain, block_id, _h, _commit = entry
+                    t0 = time.perf_counter()
+                    parts = blk.make_part_set()
+                    self.store.save_block(blk, parts, nxt.last_commit)
+                    t1 = time.perf_counter()
+                    # a commit-verified block that fails to apply is a
+                    # deterministic local fault (bad app or corrupt state),
+                    # not a peer fault
+                    try:
+                        self.state, _retain = self.block_exec.apply_block(
+                            self.state, block_id, blk)
+                    except Exception as e:
+                        raise FatalSyncError(
+                            f"apply_block failed at {blk.header.height}: {e}"
+                        ) from e
+                    t2 = time.perf_counter()
+                    st["store_s"] += t1 - t0
+                    st["abci_s"] += t2 - t1
+                    self.pool.pop()
+                    self.blocks_synced += 1
+                t_flush = time.perf_counter()
+        finally:
+            if t_flush is not None:
+                st["store_s"] += time.perf_counter() - t_flush
+            if token is not None:
+                precomputed_verdicts.reset(token)
 
     async def _punish(self, peer_ids, reason: str) -> None:
         if self.switch is None:

@@ -36,9 +36,22 @@ _HOST_SIGS_PER_SEC_ESTIMATE = 7000.0  # OpenSSL verify ~140 us/op
 _calibrated_threshold: Optional[int] = None
 
 
+# routed batches must never lose to the scalar loop: bias the calibrated
+# break-even up so near-threshold commits stay on host (the device win at
+# the margin is ~0, the loss through a slow relay is 5-10x)
+_CALIBRATION_SAFETY = 1.25
+
+
 def device_threshold() -> int:
     """Break-even batch size for the device path, measured once: dispatch
-    overhead (seconds) x host verify rate. Override: TMTPU_DEVICE_THRESHOLD."""
+    overhead (seconds) x host verify rate. Override: TMTPU_DEVICE_THRESHOLD.
+
+    The probe carries a fresh ~32KB payload (a ~150-sig commit's wire
+    weight): a payload-free jit call measures only the fixed dispatch cost
+    and badly underestimates relay-attached devices, which is how
+    sub-threshold commits ended up routed to a path 5x slower than the
+    scalar loop (BENCH_r05 verify_commit_150_device_routed at 0.18x).
+    Fresh random bytes per call defeat relay result-caching."""
     global _calibrated_threshold
     env = os.environ.get("TMTPU_DEVICE_THRESHOLD")
     if env:
@@ -51,14 +64,21 @@ def device_threshold() -> int:
             import jax.numpy as jnp
             import numpy as np
 
-            f = jax.jit(lambda x: x + 1)
-            np.asarray(f(jnp.zeros(8, jnp.int32)))  # compile
-            t0 = time.perf_counter()
-            np.asarray(f(jnp.zeros(8, jnp.int32)))
-            overhead = time.perf_counter() - t0
+            f = jax.jit(lambda x: x.astype(jnp.int32).sum())
+
+            def _probe() -> float:
+                x = np.frombuffer(os.urandom(256 * 128),
+                                  dtype=np.uint8).reshape(256, 128)
+                t0 = time.perf_counter()
+                np.asarray(f(x))
+                return time.perf_counter() - t0
+
+            _probe()  # compile
+            overhead = min(_probe(), _probe())
             _calibrated_threshold = max(
                 DEFAULT_DEVICE_THRESHOLD,
-                int(overhead * _HOST_SIGS_PER_SEC_ESTIMATE))
+                int(overhead * _HOST_SIGS_PER_SEC_ESTIMATE
+                    * _CALIBRATION_SAFETY))
         except Exception:
             _calibrated_threshold = DEFAULT_DEVICE_THRESHOLD
     return _calibrated_threshold

@@ -54,6 +54,67 @@ def _prefix_end(prefix: bytes) -> Optional[bytes]:
     return None
 
 
+class BufferedDB(DB):
+    """Read-through write buffer over a base DB.
+
+    set/delete are staged in memory; get/iterate see the overlay merged over
+    the base, so code running inside the buffered scope observes its own
+    writes (e.g. load_validators following a pointer record written earlier
+    in the same window). flush() applies everything as ONE base write_batch —
+    the per-window store-write batching the fast-sync apply plane relies on.
+    Not a transaction: flush is called on success AND on error (the staged
+    writes describe work that already happened in the app)."""
+
+    def __init__(self, base: DB) -> None:
+        self.base = base
+        self._sets: Dict[bytes, bytes] = {}
+        self._dels: set = set()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        v = self._sets.get(key)
+        if v is not None:
+            return v
+        if key in self._dels:
+            return None
+        return self.base.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._dels.discard(key)
+        self._sets[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._sets.pop(key, None)
+        self._dels.add(key)
+
+    def iterate(self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+                reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+        # materialized merge: buffered scopes are one verify-window long, so
+        # the simple, obviously-correct view beats a streaming merge
+        merged = {k: v for k, v in self.base.iterate(start, end)}
+        for k in self._dels:
+            merged.pop(k, None)
+        for k, v in self._sets.items():
+            if (start is None or k >= start) and (end is None or k < end):
+                merged[k] = v
+        for k in sorted(merged, reverse=reverse):
+            yield k, merged[k]
+
+    def write_batch(self, sets, deletes=None) -> None:
+        for k, v in sets:
+            self.set(k, v)
+        for k in deletes or []:
+            self.delete(k)
+
+    def pending(self) -> int:
+        return len(self._sets) + len(self._dels)
+
+    def flush(self) -> None:
+        if self._sets or self._dels:
+            self.base.write_batch(list(self._sets.items()), list(self._dels))
+        self._sets.clear()
+        self._dels.clear()
+
+
 class MemDB(DB):
     def __init__(self) -> None:
         self._data: Dict[bytes, bytes] = {}

@@ -24,6 +24,17 @@ class Switch:
         # good/bad events feed EWMA scores; quarantined peers are refused on
         # dial AND accept until their ban lapses
         self.trust_store = trust_store
+        # persistent peers are exempt from trust-quarantine refusals: they
+        # are operator-configured (the reference treats persistent peers as
+        # unconditional), and a transient flap must not 10-minute-ban the
+        # validator we are told to stay connected to. Their events still
+        # feed the metric for observability.
+        self._persistent_ids: set = set()
+
+    def _quarantined(self, peer_id: str) -> bool:
+        return (self.trust_store is not None
+                and peer_id not in self._persistent_ids
+                and self.trust_store.banned(peer_id))
 
     # -- reactors (switch.go:163 AddReactor) -------------------------------
 
@@ -77,7 +88,7 @@ class Switch:
         if not self._running or peer.id in self.peers or peer.id == self.node_id:
             await peer.stop()
             return
-        if self.trust_store is not None and self.trust_store.banned(peer.id):
+        if self._quarantined(peer.id):
             logger.info("%s: refusing quarantined peer %s", self.node_id[:8],
                         peer.id[:8])
             await peer.stop()
@@ -92,7 +103,9 @@ class Switch:
             raise RuntimeError("switch has no transport")
         if addr.id in self.peers or addr.id == self.node_id:
             return False
-        if self.trust_store is not None and self.trust_store.banned(addr.id):
+        if persistent:
+            self._persistent_ids.add(addr.id)
+        if self._quarantined(addr.id):
             logger.debug("%s: not dialing quarantined peer %s",
                          self.node_id[:8], addr.id[:8])
             return False
@@ -113,6 +126,10 @@ class Switch:
         """(switch.go DialPeersAsync) fire-and-forget with reconnect for
         persistent peers (exponential backoff, switch.go:430)."""
         for addr in addrs:
+            if persistent:
+                # register before the first dial so an inbound connection
+                # from the same peer is already exempt from quarantine
+                self._persistent_ids.add(addr.id)
             if addr.id in self._dial_tasks:
                 continue
             t = asyncio.create_task(self._dial_loop(addr, persistent))

@@ -35,6 +35,12 @@ DEFAULT_INTERVAL = 60.0
 HISTORY_ALPHA = 0.2          # EWMA fade per interval
 DEFAULT_BAN_THRESHOLD = 0.25
 DEFAULT_BAN_DURATION = 600.0
+# never quarantine on fewer cumulative bad events than this: a single
+# transient flap (one dropped connection scored while the metric has no
+# good history yet) can sink a fresh peer's value below the threshold,
+# and a 10-minute ban of an honest validator costs more than tolerating
+# a few bad messages from a dishonest one
+DEFAULT_MIN_BAN_EVENTS = 4.0
 
 
 class TrustMetric:
@@ -126,6 +132,7 @@ class TrustMetricStore:
                  interval: float = DEFAULT_INTERVAL,
                  ban_threshold: float = DEFAULT_BAN_THRESHOLD,
                  ban_duration: float = DEFAULT_BAN_DURATION,
+                 min_ban_events: float = DEFAULT_MIN_BAN_EVENTS,
                  now: Callable[[], float] = time.monotonic):
         self._db = db
         self._key = key
@@ -133,8 +140,10 @@ class TrustMetricStore:
         self._interval = interval
         self.ban_threshold = ban_threshold
         self.ban_duration = ban_duration
+        self.min_ban_events = min_ban_events
         self.metrics: Dict[str, TrustMetric] = {}
         self._bans: Dict[str, float] = {}  # peer id -> ban expiry (now() base)
+        self._bad_events: Dict[str, float] = {}  # cumulative, reset on parole
         self._load()
 
     def get(self, peer_id: str) -> TrustMetric:
@@ -152,7 +161,10 @@ class TrustMetricStore:
     def peer_bad(self, peer_id: str, n: float = 1.0) -> None:
         m = self.get(peer_id)
         m.record_bad(n)
-        if m.value() < self.ban_threshold:
+        total_bad = self._bad_events.get(peer_id, 0.0) + n
+        self._bad_events[peer_id] = total_bad
+        if (total_bad >= self.min_ban_events
+                and m.value() < self.ban_threshold):
             self._bans[peer_id] = self._now() + self.ban_duration
 
     def value(self, peer_id: str) -> float:
@@ -167,6 +179,7 @@ class TrustMetricStore:
             # parole: reset the metric so the peer isn't instantly re-banned
             # by its own history (reference store re-creates on re-add)
             self.metrics.pop(peer_id, None)
+            self._bad_events.pop(peer_id, None)
             return False
         return True
 
@@ -179,6 +192,9 @@ class TrustMetricStore:
             "peers": {pid: m.to_doc() for pid, m in self.metrics.items()},
             "bans": {pid: max(0.0, exp - self._now())
                      for pid, exp in self._bans.items()},
+            # persisted so a misbehaving peer can't reset its event count
+            # (and with it the ban floor) by bouncing the node
+            "bad_events": dict(self._bad_events),
         }
         self._db.set(self._key, json.dumps(doc).encode())
 
@@ -199,3 +215,5 @@ class TrustMetricStore:
         for pid, remaining in doc.get("bans", {}).items():
             if remaining > 0:
                 self._bans[pid] = now + float(remaining)
+        for pid, count in doc.get("bad_events", {}).items():
+            self._bad_events[pid] = float(count)

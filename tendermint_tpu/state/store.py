@@ -5,12 +5,13 @@ consensus params with change-height dedup (reference state/store.go:52).
 from __future__ import annotations
 
 import json
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..abci import types as abci
 from ..libs import protowire as pw
-from ..libs.db import DB
+from ..libs.db import DB, BufferedDB
 from ..types import ConsensusParams, ValidatorSet
 from ..types.basic import BlockID, PartSetHeader
 from ..types.block import Consensus
@@ -137,6 +138,31 @@ def _state_from_json(raw: bytes) -> State:
 class StateStore:
     def __init__(self, db: DB):
         self._db = db
+        # one-slot decode cache for the latest full validator record:
+        # (height, pristine ValidatorSet). Per-block ABCI BeginBlock loads
+        # the prior height's set — hex+proto decoding 1000 validators every
+        # block was a top apply-plane cost. All record writes go through
+        # this class, so the slot is refreshed at every materialization.
+        self._full_record_cache: "Optional[tuple]" = None
+
+    @contextmanager
+    def window_batch(self):
+        """Stage every write in the scope into ONE DB write-batch, flushed
+        at exit (success or error — staged writes describe blocks whose
+        ABCI commit already happened). Reads inside the scope observe the
+        staged writes (load_validators follows pointer records written
+        earlier in the same fast-sync window). Reentrant: nested scopes
+        join the outer batch."""
+        if isinstance(self._db, BufferedDB):
+            yield self
+            return
+        buf = BufferedDB(self._db)
+        self._db = buf
+        try:
+            yield self
+        finally:
+            self._db = buf.base
+            buf.flush()
 
     # -- state --
 
@@ -202,6 +228,8 @@ class StateStore:
         self._db.set(_validators_key(height), json.dumps({
             "last_changed": height, "set": vals.encode().hex(),
         }).encode())
+        # copy: the caller keeps mutating its live set (priority rotation)
+        self._full_record_cache = (height, vals.copy())
         if height > last_changed:
             # interval materialization: record this nearby full set so
             # subsequent pointers (and loads) target it instead of rolling
@@ -233,15 +261,36 @@ class StateStore:
         d = json.loads(raw.decode())
         if "set" in d:
             return ValidatorSet.decode(bytes.fromhex(d["set"]))
-        last_changed = self._resolve_target(int(d["last_changed"]), height)
-        raw2 = self._db.get(_validators_key(last_changed))
-        if raw2 is None:
+        declared = int(d["last_changed"])
+        last_changed = self._resolve_target(declared, height)
+        vals = self._load_full_record(last_changed)
+        if vals is None and last_changed != declared:
+            # the resolved target (checkpoint/materialization marker) does
+            # not hold a full record — stale marker, interrupted prune:
+            # fall back to the pointer's own declared change height rather
+            # than reporting a retained height as unloadable
+            last_changed = declared
+            vals = self._load_full_record(declared)
+        if vals is None:
             return None
-        d2 = json.loads(raw2.decode())
-        if "set" not in d2:
-            return None
-        vals = ValidatorSet.decode(bytes.fromhex(d2["set"]))
         vals.increment_proposer_priority(height - last_changed)
+        return vals
+
+    def _load_full_record(self, height: int) -> Optional[ValidatorSet]:
+        """Decode the full validator record at ``height`` (None when the
+        record is missing or a pointer); serves the hot per-block load from
+        the one-slot cache when possible."""
+        cached = self._full_record_cache
+        if cached is not None and cached[0] == height:
+            return cached[1].copy()
+        raw = self._db.get(_validators_key(height))
+        if raw is None:
+            return None
+        d = json.loads(raw.decode())
+        if "set" not in d:
+            return None
+        vals = ValidatorSet.decode(bytes.fromhex(d["set"]))
+        self._full_record_cache = (height, vals.copy())
         return vals
 
     # -- consensus params --
@@ -278,15 +327,26 @@ class StateStore:
         # commit on retention-configured nodes, and re-materializing every
         # block would re-add the cost the pointer scheme removed.
         raw = self._db.get(_validators_key(retain_height))
-        if raw is not None and b'"set"' not in raw:
+        record_is_full = raw is not None and b'"set"' in raw
+        if raw is not None and not record_is_full:
             keep = self.load_validators(retain_height)
             if keep is not None:
                 self._db.set(_validators_key(retain_height), json.dumps({
                     "last_changed": retain_height,
                     "set": keep.encode().hex(),
                 }).encode())
-        if raw is not None:
+                self._full_record_cache = (retain_height, keep)
+                record_is_full = True
+        # the checkpoint is a resolution floor: writing it while the
+        # retain-height record is still a pointer (materialization failed)
+        # would clamp every later pointer onto a non-full record and make
+        # retained heights unloadable — only advance it once the full
+        # record is confirmed on disk
+        if record_is_full:
             self._db.set(_VALS_CHECKPOINT_KEY, str(retain_height).encode())
+        if (self._full_record_cache is not None
+                and self._full_record_cache[0] < retain_height):
+            self._full_record_cache = None  # record about to be deleted
         deletes: List[bytes] = []
         for key_fn in (_validators_key, _params_key, _abci_responses_key):
             prefix = key_fn(0).rsplit(b":", 1)[0] + b":"

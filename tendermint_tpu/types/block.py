@@ -92,11 +92,27 @@ class Header:
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
 
+    def __setattr__(self, name: str, value) -> None:
+        # any field write invalidates the hash memo: headers ARE mutated
+        # after construction (fill_header, decode, test tampering), and a
+        # stale memo would be a consensus fault, not a perf bug
+        d = self.__dict__
+        if "_hash_memo" in d:
+            del d["_hash_memo"]
+        object.__setattr__(self, name, value)
+
     def hash(self) -> Optional[bytes]:
-        """Merkle root of the proto-encoded fields (block.go:440)."""
+        """Merkle root of the proto-encoded fields (block.go:440), memoized
+        until the next field write. The sync hot path hashes each header
+        several times (BlockID assembly, store save, ABCI BeginBlock), and
+        a 14-leaf merkle plus 14 proto encodes per call was measurable at
+        pipeline scale."""
         if len(self.validators_hash) == 0:
             return None
-        return merkle.hash_from_byte_slices([
+        memo = self.__dict__.get("_hash_memo")
+        if memo is not None:
+            return memo
+        h = merkle.hash_from_byte_slices([
             self.version.encode(),
             _cdc_string(self.chain_id),
             _cdc_int64(self.height),
@@ -112,6 +128,8 @@ class Header:
             _cdc_bytes(self.evidence_hash),
             _cdc_bytes(self.proposer_address),
         ])
+        self.__dict__["_hash_memo"] = h
+        return h
 
     def validate_basic(self) -> None:
         if len(self.chain_id) > 50:

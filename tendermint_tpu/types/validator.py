@@ -108,19 +108,24 @@ class Validator:
         rotates every height) is re-encoded. State persistence encodes
         whole 1000-validator sets several times per block, so this is a
         measured hot path, not speculation."""
-        key = (id(self.pub_key), self.voting_power)
+        # hold the pub_key OBJECT and compare with `is`: keying on
+        # id(self.pub_key) is an id-recycling hazard — a replaced key object
+        # can land on the freed key's address and silently serve the old
+        # encoding. The stored reference also pins the object, so the id
+        # can't be recycled while the cache lives.
         cached = self.__dict__.get("_enc_prefix")
-        if cached is None or cached[0] != key:
+        if (cached is None or cached[0] is not self.pub_key
+                or cached[1] != self.voting_power):
             w = pw.Writer()
             w.bytes(1, self.address)
             w.message(2, pubkey_proto_bytes(self.pub_key))
             w.varint(3, self.voting_power)
-            cached = (key, w.finish())
+            cached = (self.pub_key, self.voting_power, w.finish())
             self.__dict__["_enc_prefix"] = cached
         pp = self.proposer_priority
         if pp == 0:  # proto3 zero omission, like Writer.varint
-            return cached[1]
-        return cached[1] + pw.tag(4, pw.WIRE_VARINT) + pw.encode_varint(pp)
+            return cached[2]
+        return cached[2] + pw.tag(4, pw.WIRE_VARINT) + pw.encode_varint(pp)
 
     @staticmethod
     def decode(data: bytes) -> "Validator":

@@ -93,6 +93,14 @@ class ValidatorSet:
         vs.validators = [v.copy() for v in self.validators]
         vs.proposer = self.proposer
         vs._total_voting_power = self._total_voting_power
+        # membership and powers are identical, so the merkle hash carries
+        # over (priorities are not part of bytes_for_hash); re-keyed to the
+        # copy's own list so later structural mutations invalidate normally
+        cache = self.__dict__.get("_hash_cache")
+        if cache is not None and cache[0] is self.validators \
+                and cache[1] == len(self.validators):
+            vs.__dict__["_hash_cache"] = (vs.validators, len(vs.validators),
+                                          cache[2])
         return vs
 
     def _addr_index(self) -> dict:
@@ -143,10 +151,24 @@ class ValidatorSet:
         self._total_voting_power = total
 
     def hash(self) -> bytes:
-        """Merkle root of SimpleValidator encodings (validator_set.go:347)."""
-        from ..crypto import merkle
+        """Merkle root of SimpleValidator encodings (validator_set.go:347).
 
-        return merkle.hash_from_byte_slices([v.bytes_for_hash() for v in self.validators])
+        Memoized under the same invalidation contract as _addr_index: every
+        structural mutation reassigns (or resizes) the validators list, and
+        priority rotation — the only in-place mutation — does not touch
+        bytes_for_hash. validate_block hashes two 1000-validator sets per
+        block, and copy() propagates the memo, so steady-state fast sync
+        pays the merkle pass only when membership actually changes."""
+        cache = self.__dict__.get("_hash_cache")
+        if (cache is None or cache[0] is not self.validators
+                or cache[1] != len(self.validators)):
+            from ..crypto import merkle
+
+            h = merkle.hash_from_byte_slices(
+                [v.bytes_for_hash() for v in self.validators])
+            cache = (self.validators, len(self.validators), h)
+            self.__dict__["_hash_cache"] = cache
+        return cache[2]
 
     def validate_basic(self) -> None:
         if self.is_nil_or_empty():

@@ -1,0 +1,133 @@
+"""Iterative merkle equivalence: the bottom-up level-buffer implementation
+in crypto/merkle.py must be byte-identical — roots AND proofs — to the
+reference's recursive split-point formulation (crypto/merkle/tree.go:9),
+over randomized leaf sets including the 0, 1, and non-power-of-two counts
+where the two tree shapes could plausibly diverge."""
+
+import hashlib
+import random
+
+from tendermint_tpu.crypto import merkle
+
+
+# -- the old recursive implementation, kept verbatim as the test oracle ------
+
+def _rec_leaf(item: bytes) -> bytes:
+    return hashlib.sha256(b"\x00" + item).digest()
+
+
+def _rec_inner(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(b"\x01" + left + right).digest()
+
+
+def _split_point(n: int) -> int:
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def _recursive_root(items) -> bytes:
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return _rec_leaf(items[0])
+    k = _split_point(n)
+    return _rec_inner(_recursive_root(items[:k]), _recursive_root(items[k:]))
+
+
+def _recursive_aunts(items, index) -> list:
+    """Aunt list for items[index], leaf->root, built by the recursive
+    split — the exact shape Proof.compute_root consumes."""
+    n = len(items)
+    if n == 1:
+        return []
+    k = _split_point(n)
+    if index < k:
+        return _recursive_aunts(items[:k], index) + [_recursive_root(items[k:])]
+    return _recursive_aunts(items[k:], index - k) + [_recursive_root(items[:k])]
+
+
+# n = 0, 1, 2 are the base cases; primes / 2^k±1 exercise every odd-promote
+# level shape; larger sizes cover deep trees
+SIZES = [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 11, 13, 15, 16, 17, 31, 32, 33,
+         63, 64, 65, 100, 127, 128, 129, 255, 256, 257, 1000]
+
+
+def _leaf_sets():
+    rng = random.Random(0xC0FFEE)
+    for n in SIZES:
+        yield n, [rng.randbytes(rng.randrange(0, 200)) for _ in range(n)]
+
+
+def test_root_matches_recursive_reference():
+    for n, items in _leaf_sets():
+        assert merkle.hash_from_byte_slices(items) == _recursive_root(items), \
+            f"root diverged at n={n}"
+
+
+def test_proofs_match_recursive_reference_and_verify():
+    for n, items in _leaf_sets():
+        if n == 0:
+            assert merkle.proofs_from_byte_slices(items) == []
+            continue
+        root = _recursive_root(items)
+        proofs = merkle.proofs_from_byte_slices(items)
+        assert len(proofs) == n
+        for i, p in enumerate(proofs):
+            assert p.total == n and p.index == i
+            assert p.leaf_hash == _rec_leaf(items[i])
+            assert p.aunts == _recursive_aunts(items, i), \
+                f"aunts diverged at n={n}, i={i}"
+            assert p.verify(root, items[i])
+            if n > 1:  # a proof must not verify against a sibling's leaf
+                assert not p.verify(root, items[(i + 1) % n])
+
+
+def test_degenerate_leaves():
+    # empty and duplicate leaves still produce the reference trees
+    for items in ([b""], [b"", b""], [b"x"] * 7, [b""] * 12):
+        assert merkle.hash_from_byte_slices(items) == _recursive_root(items)
+        root = _recursive_root(items)
+        for i, p in enumerate(merkle.proofs_from_byte_slices(items)):
+            assert p.verify(root, items[i])
+
+
+def test_header_hash_memo_invalidates_on_mutation():
+    """The Header.hash memo must never outlive a field write (tamper
+    detection depends on recomputation)."""
+    from tendermint_tpu.types.block import Header
+
+    h = Header(chain_id="c", height=3, validators_hash=b"\x01" * 32,
+               proposer_address=b"\x02" * 20)
+    first = h.hash()
+    assert h.hash() == first  # memo hit
+    h.app_hash = b"\x09" * 32
+    assert h.hash() != first
+    h.app_hash = b""
+    assert h.hash() == first
+
+
+def test_validator_set_hash_memo_tracks_membership():
+    from tendermint_tpu import crypto
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    privs = [crypto.Ed25519PrivKey.generate(bytes([i + 1]) * 32)
+             for i in range(4)]
+    vals = [Validator(p.pub_key().address(), p.pub_key(), 10)
+            for p in privs]
+    vs = ValidatorSet(vals)
+    h0 = vs.hash()
+    # priority rotation must NOT change the hash (it is not committed)
+    vs.increment_proposer_priority(3)
+    assert vs.hash() == h0
+    # copies carry the memo and stay equal
+    assert vs.copy().hash() == h0
+    # membership changes must invalidate
+    vs.update_with_change_set([Validator(vals[0].address, vals[0].pub_key, 99)])
+    assert vs.hash() != h0
+    # and the recomputed hash matches a from-scratch set with the same power
+    fresh = ValidatorSet([Validator(v.address, v.pub_key, 99 if i == 0 else 10)
+                          for i, v in enumerate(vals)])
+    assert vs.hash() == fresh.hash()

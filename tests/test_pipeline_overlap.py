@@ -1,0 +1,272 @@
+"""Apply-plane pipeline: ordering, failure isolation, lookahead
+invalidation, and per-window store write-batching
+(blockchain/reactor.py stage A/B pipeline; ISSUE 2 tentpole)."""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.blockchain import BlockchainReactor, BlockPool
+from tendermint_tpu.blockchain.reactor import FatalSyncError, VERIFY_WINDOW
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis
+from tendermint_tpu.state.execution import EmptyEvidencePool, NoOpMempool
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import (
+    BlockID,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    SignedMsgType,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.block import Commit
+
+CHAIN_ID = "pipe-chain"
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """A 41-block committed chain (40 appliable windows' worth + the commit
+    carrier) plus its genesis, built once per module."""
+    pv = MockPV(crypto.Ed25519PrivKey.generate(b"\x31" * 32))
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID, genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    state = state_from_genesis(genesis)
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    state_store = StateStore(MemDB())
+    state_store.save(state)
+    block_store = BlockStore(MemDB())
+    executor = BlockExecutor(state_store, conns.consensus, NoOpMempool(),
+                             EmptyEvidencePool(), block_store)
+    blocks = []
+    last_commit = Commit(0, 0, BlockID(), [])
+    for h in range(1, 42):
+        proposer = state.validators.get_proposer().address
+        block, parts = state.make_block(h, [f"h{h}=v".encode()], last_commit,
+                                        [], proposer)
+        bid = BlockID(block.hash(), parts.header())
+        vs = VoteSet(state.chain_id, h, 0, SignedMsgType.PRECOMMIT,
+                     state.validators)
+        v = Vote(SignedMsgType.PRECOMMIT, h, 0, bid, block.header.time_ns + 1,
+                 state.validators.validators[0].address, 0)
+        pv.sign_vote(state.chain_id, v)
+        vs.add_vote(v)
+        blocks.append(block)
+        state, _ = executor.apply_block(state, bid, block)
+        last_commit = vs.make_commit()
+    conns.stop()
+    yield genesis, blocks
+
+
+def _fresh_reactor(genesis):
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    state = state_from_genesis(genesis)
+    state_store = StateStore(MemDB())
+    state_store.save(state)
+    block_store = BlockStore(MemDB())
+    executor = BlockExecutor(state_store, conns.consensus, NoOpMempool(),
+                             EmptyEvidencePool(), block_store)
+    reactor = BlockchainReactor(state, executor, block_store, fast_sync=True)
+    reactor.pool = BlockPool(1)
+    return reactor, conns
+
+
+def _fill_pool(reactor, blocks, upto):
+    reactor.pool.set_peer_range("src", 1, upto)
+    filled = True
+    while filled:
+        reqs = reactor.pool.schedule_requests()
+        filled = bool(reqs)
+        for pid, h in reqs:
+            reactor.pool.add_block(pid, blocks[h - 1])
+
+
+def test_pipeline_ordering_and_no_early_commit(chain, monkeypatch):
+    """Window N+1's stage A runs while window N applies, but commits
+    nothing: store and state advance only through the strictly-ordered
+    apply stage."""
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    genesis, blocks = chain
+    reactor, conns = _fresh_reactor(genesis)
+    events = []  # (kind, height, t)
+
+    real_stage_a = reactor._stage_a
+
+    def spy_stage_a(window, pairs, *a, **kw):
+        start_h = pairs[0][0].header.height
+        events.append(("prepare_start", start_h, time.perf_counter()))
+        assert reactor.store.height() < start_h
+        out = real_stage_a(window, pairs, *a, **kw)
+        # the prepared window must not have committed anything: while its
+        # stage A runs, only the PREVIOUS window may be applying, so the
+        # store never reaches this window's heights before apply consumes
+        # the prepared verdicts
+        assert reactor.store.height() < start_h
+        events.append(("prepare_end", start_h, time.perf_counter()))
+        return out
+
+    real_apply = reactor.block_exec.apply_block
+
+    def spy_apply(state, block_id, block):
+        events.append(("apply", block.header.height, time.perf_counter()))
+        return real_apply(state, block_id, block)
+
+    monkeypatch.setattr(reactor, "_stage_a", spy_stage_a)
+    monkeypatch.setattr(reactor.block_exec, "apply_block", spy_apply)
+
+    async def drive():
+        _fill_pool(reactor, blocks, 41)
+        while reactor.blocks_synced < 40:
+            before = reactor.blocks_synced
+            await reactor._process_window()
+            assert reactor.blocks_synced > before
+    asyncio.run(drive())
+    conns.stop()
+
+    applies = [(h, t) for k, h, t in events if k == "apply"]
+    assert [h for h, _t in applies] == list(range(1, 41)), \
+        "apply order must be strictly sequential"
+    assert reactor.stage_times["pipelined_windows"] >= 1, \
+        "lookahead never engaged"
+    # window 2's prepare started before window 1 finished applying
+    prep2_start = next(t for k, h, t in events
+                       if k == "prepare_start" and h == VERIFY_WINDOW + 1)
+    last_apply_w1 = next(t for h, t in applies if h == VERIFY_WINDOW)
+    assert prep2_start < last_apply_w1, \
+        "window N+1 prepare did not overlap window N apply"
+    # and its verdicts were consumed only after window 1 fully applied
+    prep2_end = next(t for k, h, t in events
+                     if k == "prepare_end" and h == VERIFY_WINDOW + 1)
+    first_apply_w2 = next(t for h, t in applies if h == VERIFY_WINDOW + 1)
+    assert first_apply_w2 > prep2_end
+
+
+def test_failed_window_aborts_lookahead(chain, monkeypatch):
+    """A deterministic apply fault in window N surfaces as FatalSyncError,
+    persists exactly the blocks applied before it, and discards window
+    N+1's prepared results."""
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    genesis, blocks = chain
+    reactor, conns = _fresh_reactor(genesis)
+    real_apply = reactor.block_exec.apply_block
+    boom_at = VERIFY_WINDOW + 4  # mid window 2
+
+    def failing_apply(state, block_id, block):
+        if block.header.height == boom_at:
+            raise RuntimeError("app corrupted")
+        return real_apply(state, block_id, block)
+
+    monkeypatch.setattr(reactor.block_exec, "apply_block", failing_apply)
+
+    async def drive():
+        _fill_pool(reactor, blocks, 41)
+        await reactor._process_window()          # window 1 ok, prepares 2
+        assert reactor.blocks_synced == VERIFY_WINDOW
+        with pytest.raises(FatalSyncError):
+            await reactor._process_window()      # window 2 hits the fault
+    asyncio.run(drive())
+
+    assert reactor.blocks_synced == boom_at - 1
+    # the window's writes up to (and including, store-ahead-by-one: save
+    # precedes apply, as in the unpipelined loop) the faulting block were
+    # flushed; state stops at the last applied height and nothing PAST the
+    # fault ever landed — handshake replay reconciles the one-block gap
+    assert reactor.store.height() == boom_at
+    assert reactor.store.load_block(boom_at - 1) is not None
+    assert reactor.store.load_block(boom_at + 1) is None
+    assert reactor.block_exec.state_store.load().last_block_height \
+        == boom_at - 1
+    # the lookahead slot did not outlive the fault
+    assert reactor._prepared is None
+    conns.stop()
+
+
+def test_stale_lookahead_discarded_after_redo(chain, monkeypatch):
+    """pool.redo between prepare and consume (bad peer mid-sync) must
+    invalidate the prepared window instead of applying stale blocks."""
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    genesis, blocks = chain
+    reactor, conns = _fresh_reactor(genesis)
+
+    async def drive():
+        _fill_pool(reactor, blocks, 41)
+        await reactor._process_window()  # applies window 1, prepares window 2
+        assert reactor.blocks_synced == VERIFY_WINDOW
+        assert reactor._prepared is not None
+        # the provider turns out bad: every outstanding block is dropped
+        reactor.pool.redo(reactor.pool.height)
+        await reactor._process_window()  # must not apply the stale window
+        assert reactor.blocks_synced == VERIFY_WINDOW
+        assert reactor._prepared is None
+        # re-downloaded blocks (same content, new objects) resync cleanly
+        _fill_pool(reactor, blocks, 41)
+        while reactor.blocks_synced < 40:
+            await reactor._process_window()
+    asyncio.run(drive())
+    assert reactor.store.height() == 40
+    conns.stop()
+
+
+def test_window_batch_one_write_batch_per_window(chain, monkeypatch):
+    """All store writes of a window land in one DB write-batch per store,
+    and reads inside the scope observe the staged writes."""
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    genesis, blocks = chain
+
+    class CountingDB(MemDB):
+        def __init__(self):
+            super().__init__()
+            self.batches = 0
+            self.singles = 0
+
+        def set(self, key, value):
+            self.singles += 1
+            super().set(key, value)
+
+        def write_batch(self, sets, deletes=None):
+            self.batches += 1
+            with self._lock:
+                for k, v in sets:
+                    super(CountingDB, self).set(k, v)
+                for k in deletes or []:
+                    super().delete(k)
+
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    state = state_from_genesis(genesis)
+    sdb, bdb = CountingDB(), CountingDB()
+    state_store = StateStore(sdb)
+    state_store.save(state)
+    block_store = BlockStore(bdb)
+    executor = BlockExecutor(state_store, conns.consensus, NoOpMempool(),
+                             EmptyEvidencePool(), block_store)
+    reactor = BlockchainReactor(state, executor, block_store, fast_sync=True)
+    reactor.pool = BlockPool(1)
+
+    async def drive():
+        _fill_pool(reactor, blocks, 18)  # exactly one window + carrier
+        sdb.singles = sdb.batches = bdb.singles = bdb.batches = 0
+        await reactor._process_window()
+    asyncio.run(drive())
+
+    assert reactor.blocks_synced == VERIFY_WINDOW
+    # one flush per store for the whole window, nothing written singly
+    assert bdb.batches == 1 and bdb.singles == 0
+    assert sdb.batches == 1 and sdb.singles == 0
+    # and the flushed data is complete: a fresh store view loads every block
+    fresh = BlockStore(bdb)
+    for h in range(1, VERIFY_WINDOW + 1):
+        assert fresh.load_block(h) is not None
+    conns.stop()

@@ -348,3 +348,135 @@ def test_materialization_does_not_mask_prune_floor():
             [v.proposer_priority for v in want.validators]
     # and pruned heights are honestly gone
     assert ss.load_validators(90) is None
+
+
+def _mk_host_valset(n=4, power=10, seed=0x40):
+    """Like _mk_pointer_valset but built on the host crypto backend only —
+    runs in containers without the `cryptography` package."""
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    privs = [crypto.Ed25519PrivKey.generate(bytes([seed + i]) * 32)
+             for i in range(n)]
+    return ValidatorSet([Validator(p.pub_key().address(), p.pub_key(), power)
+                         for p in privs])
+
+
+def test_prune_checkpoint_written_only_after_full_record_confirmed():
+    """Regression (ISSUE 2 satellite): prune_states must not advance the
+    validator checkpoint when materializing the retain-height record fails —
+    a checkpoint floor pointing at a non-full record makes every retained
+    height unloadable."""
+    import json
+
+    from tendermint_tpu.state import store as st
+
+    vs = _mk_host_valset()
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)
+    for h in range(3, 10):
+        ss._save_validators(h, vs.copy_increment_proposer_priority(h - 2),
+                            last_changed=2)
+    # sabotage: the pointer target vanishes (interrupted earlier prune), so
+    # materialization at the retain height cannot succeed
+    ss._db.delete(st._validators_key(2))
+    ss._full_record_cache = None
+    ss.prune_states(6)
+    assert ss._db.get(st._VALS_CHECKPOINT_KEY) is None, \
+        "checkpoint advanced over a dangling pointer"
+    # the happy path still writes it
+    ss2 = StateStore(MemDB())
+    ss2._save_validators(2, vs)
+    for h in range(3, 10):
+        ss2._save_validators(h, vs.copy_increment_proposer_priority(h - 2),
+                             last_changed=2)
+    ss2.prune_states(6)
+    assert ss2._db.get(st._VALS_CHECKPOINT_KEY) == b"6"
+    raw = json.loads(ss2._db.get(st._validators_key(6)).decode())
+    assert "set" in raw
+
+
+def test_load_validators_falls_back_to_declared_change_height():
+    """Regression (ISSUE 2 satellite): when the checkpoint/materialization
+    marker resolves a pointer onto a height that holds NO full record (stale
+    marker, interrupted write), load_validators must fall back to the
+    pointer's own declared last_changed instead of reporting the height
+    unloadable."""
+    import json
+
+    from tendermint_tpu.state import store as st
+
+    vs = _mk_host_valset(seed=0x50)
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)  # the only full record
+    ss._db.set(st._validators_key(9),
+               json.dumps({"last_changed": 2}).encode())
+    # stale marker: claims a materialized record at 7 that never landed
+    ss._db.set(st._VALS_MATERIALIZED_KEY, b"7")
+    got = ss.load_validators(9)
+    assert got is not None, "stale marker made a retained height unloadable"
+    want = vs.copy_increment_proposer_priority(7)
+    assert [v.proposer_priority for v in got.validators] == \
+        [v.proposer_priority for v in want.validators]
+    # same through a stale checkpoint
+    ss._db.set(st._VALS_CHECKPOINT_KEY, b"8")
+    got = ss.load_validators(9)
+    assert got is not None
+
+
+def test_full_record_cache_serves_pristine_copies():
+    """The one-slot decode cache must hand out independent copies: a caller
+    mutating its loaded set (priority rolls) must not leak into later
+    loads."""
+    vs = _mk_host_valset(seed=0x60)
+    ss = StateStore(MemDB())
+    ss._save_validators(2, vs)
+    for h in range(3, 8):
+        ss._save_validators(h, vs.copy_increment_proposer_priority(h - 2),
+                            last_changed=2)
+    a = ss.load_validators(5)
+    a.increment_proposer_priority(10)  # caller-side mutation
+    b = ss.load_validators(5)
+    want = vs.copy_increment_proposer_priority(3)
+    assert [v.proposer_priority for v in b.validators] == \
+        [v.proposer_priority for v in want.validators]
+
+
+def test_buffered_db_read_through_and_single_flush():
+    from tendermint_tpu.libs.db import BufferedDB
+
+    base = MemDB()
+    base.set(b"a", b"1")
+    base.set(b"b", b"2")
+    buf = BufferedDB(base)
+    buf.set(b"c", b"3")
+    buf.delete(b"a")
+    buf.set(b"b", b"22")
+    # read-through sees staged writes, base does not
+    assert buf.get(b"c") == b"3" and buf.get(b"a") is None
+    assert buf.get(b"b") == b"22"
+    assert base.get(b"c") is None and base.get(b"a") == b"1"
+    assert [k for k, _ in buf.iterate()] == [b"b", b"c"]
+    assert [v for _, v in buf.iterate()] == [b"22", b"3"]
+    buf.flush()
+    assert base.get(b"c") == b"3" and base.get(b"a") is None
+    assert base.get(b"b") == b"22"
+    assert buf.pending() == 0
+
+
+def test_state_store_window_batch_reads_own_writes():
+    """Pointer records written inside a window batch must be visible to
+    loads later in the same window (apply_block loads height-1's set)."""
+    vs = _mk_host_valset(seed=0x70)
+    ss = StateStore(MemDB())
+    with ss.window_batch():
+        ss._save_validators(2, vs)
+        for h in range(3, 6):
+            ss._save_validators(h, vs.copy_increment_proposer_priority(h - 2),
+                                last_changed=2)
+        got = ss.load_validators(4)
+        assert got is not None
+        # reentrancy: a nested scope joins the outer batch
+        with ss.window_batch():
+            assert ss.load_validators(5) is not None
+    # flushed: visible without the buffer
+    assert ss.load_validators(5) is not None

@@ -116,8 +116,16 @@ def test_header_proto_roundtrip_and_hash():
     assert got == h
     assert h.hash() is not None and len(h.hash()) == 32
     # hash must change when a committed field changes
-    h2 = Header(**{**h.__dict__, "app_hash": b"\x10" * 32})
+    from dataclasses import replace
+
+    h2 = replace(h, app_hash=b"\x10" * 32)
     assert h2.hash() != h.hash()
+    # in-place mutation must invalidate the hash memo, not serve stale bytes
+    before = h.hash()
+    h.app_hash = b"\x11" * 32
+    assert h.hash() != before
+    h.app_hash = b"\x06" * 32
+    assert h.hash() == before
 
 
 def test_header_hash_nil_without_validators_hash():
