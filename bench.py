@@ -495,7 +495,7 @@ def bench_fast_sync_pipeline():
     finally:
         del os.environ["TMTPU_BATCH_BACKEND"]
     rate = n_blocks / dev
-    st = reactor.stage_times
+    st = reactor.stage_breakdown()  # derived from BlocksyncMetrics histograms
     assert st["pipelined_windows"] > 0, \
         "apply pipeline never engaged: every window was prepared inline"
     # hash+store share of end-to-end pipeline wall-clock: the two apply-plane
@@ -712,28 +712,64 @@ CONFIGS = {
 }
 
 
+def _emit_trace(path: str) -> None:
+    """Write the run's span trace as Chrome trace-event JSON (loadable at
+    https://ui.perfetto.dev) and emit a per-span stage-histogram summary
+    line into the BENCH_*.json payload."""
+    import sys
+
+    from tendermint_tpu.libs.trace import tracer
+
+    tracer.write(path)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+    try:
+        from trace_summary import summarize
+
+        spans = summarize(tracer.events())
+    finally:
+        sys.path.pop(0)
+    _emit("trace_summary", float(len(tracer.events())), "events", 0.0,
+          trace_path=path, spans=spans)
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all",
                     choices=list(CONFIGS) + ["all"],
                     help="BASELINE.json config; default runs every config, "
                          "flagship (10k) last")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="enable the span tracer (libs/trace.py) for the "
+                         "whole run and write Chrome trace-event JSON here; "
+                         "also emits a per-span summary line")
     args = ap.parse_args()
     _enable_compile_cache()
-    if args.config == "all":
-        # flagship last: the driver records the final line. The remote
-        # relay occasionally drops a compile mid-flight — retry each
-        # config once before reporting it failed.
-        for key in ("2", "3", "4", "5", "1", "10k"):
-            for attempt in (1, 2):
-                try:
-                    CONFIGS[key]()
-                    break
-                except Exception as e:
-                    if attempt == 2:
-                        _emit(f"config_{key}_failed", 0.0, "error", 0.0,
-                              error=f"{type(e).__name__}: {e}")
-                    else:
-                        time.sleep(5.0)
-    else:
-        CONFIGS[args.config]()
+    from tendermint_tpu.libs.trace import tracer as _tracer
+
+    if args.trace_out:
+        _tracer.enable()
+    try:
+        if args.config == "all":
+            # flagship last: the driver records the final line. The remote
+            # relay occasionally drops a compile mid-flight — retry each
+            # config once before reporting it failed.
+            for key in ("2", "3", "4", "5", "1", "10k"):
+                for attempt in (1, 2):
+                    try:
+                        with _tracer.span(f"config_{key}"):
+                            CONFIGS[key]()
+                        break
+                    except Exception as e:
+                        if attempt == 2:
+                            _emit(f"config_{key}_failed", 0.0, "error", 0.0,
+                                  error=f"{type(e).__name__}: {e}")
+                        else:
+                            time.sleep(5.0)
+        else:
+            with _tracer.span(f"config_{args.config}"):
+                CONFIGS[args.config]()
+    finally:
+        # a failed run is exactly when the trace matters: flush the ring
+        # to disk before any exception propagates
+        if args.trace_out:
+            _emit_trace(args.trace_out)

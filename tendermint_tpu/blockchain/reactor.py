@@ -45,6 +45,8 @@ from ..store import BlockStore
 from ..types.basic import BlockID
 from ..types.block import Block
 from ..crypto.batch import BatchVerifier, precomputed_verdicts
+from ..libs.metrics import BlocksyncMetrics, Registry
+from ..libs.trace import tracer
 from ..types.validator_set import verify_commit_light_batched
 from .msgs import (
     BlockRequest,
@@ -110,11 +112,26 @@ class BlockchainReactor(Reactor):
         self.blocks_synced = 0
         # the pipeline's single lookahead slot (backpressure bound = 1)
         self._prepared: Optional[_PreparedWindow] = None
-        # cumulative stage wall-clock, exported by bench.py as the pipeline
-        # breakdown (hash+store share of end-to-end sync time)
-        self.stage_times = {"hash_s": 0.0, "verify_s": 0.0, "store_s": 0.0,
-                            "abci_s": 0.0, "pipelined_windows": 0,
-                            "inline_windows": 0}
+        # per-stage histograms + pipeline counters (libs/metrics.py
+        # BlocksyncMetrics). The node rebinds this to its shared registry so
+        # the series land on /metrics; standalone reactors (bench, tests)
+        # keep this private set. bench.py derives the old stage_times
+        # breakdown from the histogram sums via stage_breakdown().
+        self.metrics = BlocksyncMetrics(Registry())
+
+    def stage_breakdown(self) -> dict:
+        """The bench-facing view of the stage metrics: cumulative seconds
+        per stage + window counters — the same numbers the old stage_times
+        dict accumulated, now derived from the metric set."""
+        m = self.metrics
+        return {
+            "hash_s": m.stage_seconds.sum_value("hash"),
+            "verify_s": m.stage_seconds.sum_value("verify"),
+            "store_s": m.stage_seconds.sum_value("store"),
+            "abci_s": m.stage_seconds.sum_value("exec"),
+            "pipelined_windows": int(m.pipelined_windows_total.value()),
+            "inline_windows": int(m.inline_windows_total.value()),
+        }
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=5,
@@ -277,9 +294,9 @@ class BlockchainReactor(Reactor):
                 None, self._stage_a, window, pairs, cur_vals_hash,
                 self.state.last_validators, self.state.validators,
                 self.state.chain_id)
-            self.stage_times["inline_windows"] += 1
+            self.metrics.inline_windows_total.inc()
         else:
-            self.stage_times["pipelined_windows"] += 1
+            self.metrics.pipelined_windows_total.inc()
 
         # 2-deep pipeline: kick off stage A for the NEXT window on a worker
         # thread before this window's apply starts. Snapshot the pre-apply
@@ -299,6 +316,12 @@ class BlockchainReactor(Reactor):
                     None, self._stage_a, nwindow, npairs, prep.vals_hash,
                     self.state.validators, self.state.validators,
                     self.state.chain_id)
+        elif next_start + 1 <= self.pool.max_peer_height():
+            # download plane starved the lookahead: a peer advertises the
+            # next window's pair (next_start and its commit carrier) but the
+            # blocks weren't here when stage A wanted to start. Chain
+            # exhaustion (end of sync) is NOT a stall.
+            self.metrics.lookahead_stalls_total.inc()
         try:
             await self._apply_window(prep)
         except BaseException:
@@ -328,12 +351,15 @@ class BlockchainReactor(Reactor):
             return None
         if (prep.start_height != self.pool.height
                 or prep.vals_hash != self.state.validators.hash()):
+            self.metrics.stale_window_discards_total.inc()
             return None
         window = self.pool.peek_from(prep.start_height, len(prep.window))
         if len(window) < len(prep.window):
+            self.metrics.stale_window_discards_total.inc()
             return None
         for (blk, peer_id), (pblk, ppeer_id) in zip(window, prep.window):
             if blk is not pblk or peer_id != ppeer_id:
+                self.metrics.stale_window_discards_total.inc()
                 return None
         return prep
 
@@ -355,6 +381,13 @@ class BlockchainReactor(Reactor):
         dual-plane signature precompute, and the batched light verify. All
         results memoize on the immutable block/commit instances, so the
         apply stage re-derives none of it."""
+        with tracer.span("verify_window", height=pairs[0][0].header.height,
+                         n_blocks=len(pairs)):
+            return self._stage_a_inner(window, pairs, vals_hash, first_vals,
+                                       vals, chain_id)
+
+    def _stage_a_inner(self, window, pairs, vals_hash, first_vals, vals,
+                       chain_id) -> _PreparedWindow:
         t0 = time.perf_counter()
         entries = []
         for blk, _p, nxt, _np in pairs:
@@ -380,8 +413,8 @@ class BlockchainReactor(Reactor):
             if token is not None:
                 precomputed_verdicts.reset(token)
         t2 = time.perf_counter()
-        self.stage_times["hash_s"] += t1 - t0
-        self.stage_times["verify_s"] += t2 - t1
+        self.metrics.stage_seconds.labels("hash").observe(t1 - t0)
+        self.metrics.stage_seconds.labels("verify").observe(t2 - t1)
         return _PreparedWindow(
             start_height=pairs[0][0].header.height, vals_hash=vals_hash,
             window=window[:len(pairs) + 1], pairs=pairs, entries=entries,
@@ -417,7 +450,7 @@ class BlockchainReactor(Reactor):
                      for blk, _p, _n, _np in pairs) * 2
         if n_sigs < PRECOMPUTE_MIN_SIGS:
             return None
-        bv = BatchVerifier()
+        bv = BatchVerifier(plane="light")
         keys: List[Tuple[bytes, bytes, bytes]] = []
 
         def _add(pub, msg, sig):
@@ -459,9 +492,15 @@ class BlockchainReactor(Reactor):
     # -- stage B: apply (event loop, strict height order) -------------------
 
     async def _apply_window(self, prep: _PreparedWindow) -> None:
+        with tracer.span("apply_window", height=prep.start_height,
+                         n_blocks=len(prep.pairs)):
+            await self._apply_window_inner(prep)
+
+    async def _apply_window_inner(self, prep: _PreparedWindow) -> None:
         token = (precomputed_verdicts.set(prep.pre)
                  if prep.pre is not None else None)
-        st = self.stage_times
+        st = self.metrics.stage_seconds
+        applied = 0
         t_flush = None
         try:
             # every write the window produces — block parts, commits, seen
@@ -497,14 +536,18 @@ class BlockchainReactor(Reactor):
                             f"apply_block failed at {blk.header.height}: {e}"
                         ) from e
                     t2 = time.perf_counter()
-                    st["store_s"] += t1 - t0
-                    st["abci_s"] += t2 - t1
+                    st.labels("store").observe(t1 - t0)
+                    st.labels("exec").observe(t2 - t1)
                     self.pool.pop()
                     self.blocks_synced += 1
+                    applied += 1
                 t_flush = time.perf_counter()
         finally:
             if t_flush is not None:
-                st["store_s"] += time.perf_counter() - t_flush
+                # the batched per-window DB flush is store-stage time too
+                st.labels("store").observe(time.perf_counter() - t_flush)
+            if applied:
+                self.metrics.window_blocks.observe(applied)
             if token is not None:
                 precomputed_verdicts.reset(token)
 

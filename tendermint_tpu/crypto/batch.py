@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import contextvars
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import Ed25519PubKey, PubKey
+from ..libs.trace import tracer
 
 # below this many signatures the host scalar loop beats a device round-trip.
 # The break-even point depends on per-dispatch overhead: ~100 us on a local
@@ -99,14 +101,43 @@ stats = {
     "largest_batch": 0,
 }
 
+# CryptoMetrics hook, wired by the node (same idiom as p2p's
+# set_p2p_metrics): None outside a node process, so library callers
+# (tests, bench, light client as a library) pay one None-check per batch
+metrics = None
+
+
+def set_crypto_metrics(m) -> None:
+    global metrics
+    metrics = m
+
+
+def _padded_slots(n: int, chunk: int = 2048) -> int:
+    """Device slots a batch of n occupies after padding: the stream path
+    rounds up to whole chunks, the one-call path to the next power-of-two
+    lane bucket (ed25519_jax.verify._pad_to). Used for the pad-waste gauge
+    only — approximate is fine, wrong can't corrupt anything."""
+    if n <= 0:
+        return 0
+    if n > chunk:
+        return -(-n // chunk) * chunk
+    size = 128  # LANE
+    while size < n:
+        size *= 2
+    return size
+
 
 class BatchVerifier:
     def __init__(self, backend: Optional[str] = None,
-                 device_threshold: Optional[int] = None):
+                 device_threshold: Optional[int] = None,
+                 plane: str = "votes"):
         self._backend = backend or os.environ.get("TMTPU_BATCH_BACKEND") or "auto"
         if self._backend not in ("auto", "jax", "host"):
             raise ValueError(f"unknown batch backend {self._backend!r}")
         self._threshold = device_threshold
+        # metric label only: which verification plane this batch serves
+        # ("votes" live commits, "light" light/fast-sync, "evidence")
+        self.plane = plane
         self._pks: List[bytes] = []
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
@@ -140,6 +171,8 @@ class BatchVerifier:
                 out = np.array(hits, dtype=bool)
                 stats["precomputed_batches"] += 1
                 stats["precomputed_sigs"] += n
+                if metrics is not None:
+                    metrics.precomputed_hits_total.labels(self.plane).inc()
                 return bool(out.all()), out
 
         backend = self._backend
@@ -151,24 +184,43 @@ class BatchVerifier:
         non_ed_idx = {i: pk for i, pk in non_ed}
         stats["device_batches" if backend == "jax" else "host_batches"] += 1
         stats["device_sigs" if backend == "jax" else "host_sigs"] += n
-        if backend == "jax":
-            from .ed25519_jax import batch_verify_stream
+        route = "device" if backend == "jax" else "scalar"
+        t0 = time.perf_counter()
+        # tracer.span is a shared no-op when disabled (one attribute check
+        # inside span() plus the kwargs dict — noise next to any verify)
+        with tracer.span("batch_verify", n=n, route=route, plane=self.plane):
+            if backend == "jax":
+                from .ed25519_jax import batch_verify_stream
 
-            ed_pos = [i for i in range(n) if i not in non_ed_idx]
-            out = np.zeros(n, dtype=bool)
-            if ed_pos:
-                # batch_verify_stream == batch_verify below one chunk; above,
-                # it scans fixed-size chunks inside one device execution
-                ed_out = batch_verify_stream([pks[i] for i in ed_pos],
-                                             [msgs[i] for i in ed_pos],
-                                             [sigs[i] for i in ed_pos])
-                out[ed_pos] = ed_out
-            # rare non-ed25519 keys verify on host, verdicts merged by index
-            for i, pub in non_ed_idx.items():
-                out[i] = pub.verify_signature(msgs[i], sigs[i])
-        else:
-            out = np.zeros(n, dtype=bool)
-            for i in range(n):
-                pub = non_ed_idx.get(i) or Ed25519PubKey(pks[i])
-                out[i] = pub.verify_signature(msgs[i], sigs[i])
+                ed_pos = [i for i in range(n) if i not in non_ed_idx]
+                out = np.zeros(n, dtype=bool)
+                if ed_pos:
+                    # batch_verify_stream == batch_verify below one chunk;
+                    # above, it scans fixed-size chunks inside one device
+                    # execution
+                    ed_out = batch_verify_stream([pks[i] for i in ed_pos],
+                                                 [msgs[i] for i in ed_pos],
+                                                 [sigs[i] for i in ed_pos])
+                    out[ed_pos] = ed_out
+                # rare non-ed25519 keys verify on host, verdicts merged by
+                # index
+                for i, pub in non_ed_idx.items():
+                    out[i] = pub.verify_signature(msgs[i], sigs[i])
+            else:
+                out = np.zeros(n, dtype=bool)
+                for i in range(n):
+                    pub = non_ed_idx.get(i) or Ed25519PubKey(pks[i])
+                    out[i] = pub.verify_signature(msgs[i], sigs[i])
+        if metrics is not None:
+            elapsed = time.perf_counter() - t0
+            metrics.routing_decisions_total.labels(route, self.plane).inc()
+            metrics.batch_size.labels(route, self.plane).observe(n)
+            metrics.verify_latency_seconds.labels(route,
+                                                  self.plane).observe(elapsed)
+            if route == "device":
+                n_ed = n - len(non_ed_idx)  # only ed25519 rows ride the kernel
+                slots = _padded_slots(n_ed)
+                if slots:
+                    metrics.pad_waste_ratio.labels(self.plane).set(
+                        (slots - n_ed) / slots)
         return bool(out.all()), out

@@ -29,7 +29,11 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
+
+from ..libs.trace import tracer
+from . import batch as _batch  # module ref: reads the live metrics hook
 
 logger = logging.getLogger("tmtpu.votebatch")
 
@@ -126,6 +130,14 @@ class BatchVoteVerifier:
 
         n = len(batch)
         loop = asyncio.get_running_loop()
+        cm = _batch.metrics
+        if cm is not None:
+            # depth AT flush time = the flush size plus whatever already
+            # queued behind it while this coroutine was scheduled
+            cm.vote_queue_depth.set(n + len(self._pending))
+        t_flush0 = time.perf_counter()
+        t_v0 = t_flush0  # start of the verify work actually charged
+        route = "scalar"
 
         def _host_verify():
             return [Ed25519PubKey(pk).verify_signature(m, s)
@@ -135,6 +147,7 @@ class BatchVoteVerifier:
             if n >= self.min_device_batch and not self._device_warming:
                 from .ed25519_jax import batch_verify_stream
 
+                route = "device"
                 pks = [b[1] for b in batch]
                 msgs = [b[2] for b in batch]
                 sigs = [b[3] for b in batch]
@@ -144,6 +157,9 @@ class BatchVoteVerifier:
                     out = await asyncio.wait_for(
                         asyncio.shield(dev), self.device_timeout_s)
                 except asyncio.TimeoutError:
+                    route = "scalar"
+                    # the timeout wait is flush latency, not verify latency
+                    t_v0 = time.perf_counter()
                     # liveness over throughput: verify THIS batch on host
                     # now; let the (probably compiling) device call finish
                     # in the background and re-enable the device path then
@@ -163,6 +179,9 @@ class BatchVoteVerifier:
                     self.stats["device_timeouts"] += 1
                     self.stats["host_batches"] += 1
                     self.stats["host_sigs"] += n
+                    if cm is not None:
+                        cm.device_fallbacks_total.labels(
+                            "device_timeout").inc()
                     results = await loop.run_in_executor(None, _host_verify)
                 else:
                     self.stats["device_batches"] += 1
@@ -180,6 +199,17 @@ class BatchVoteVerifier:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        if cm is not None:
+            now = time.perf_counter()
+            cm.vote_flush_latency_seconds.labels(route).observe(now - t_flush0)
+            cm.batch_size.labels(route, "votes").observe(n)
+            cm.routing_decisions_total.labels(route, "votes").inc()
+            # verify-only time (the same semantics batch.py gives this
+            # series): on a device-timeout fallback t_v0 excludes the wait
+            cm.verify_latency_seconds.labels(route, "votes").observe(
+                now - t_v0)
+        if tracer.enabled:
+            tracer.instant("vote_flush", n=n, route=route)
         for (key, _pk, _m, _s, fut), ok in zip(batch, results):
             self._cache[key] = ok
             self._cache.move_to_end(key)

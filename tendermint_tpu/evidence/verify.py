@@ -52,7 +52,7 @@ def verify_duplicate_vote(e: DuplicateVoteEvidence, chain_id: str,
             f"match ({e.total_voting_power} != {val_set.total_voting_power()})")
 
     # Both signatures in one device batch (verify.go:214,217).
-    bv = BatchVerifier()
+    bv = BatchVerifier(plane="evidence")
     bv.add(pub_key, e.vote_a.sign_bytes(chain_id), e.vote_a.signature)
     bv.add(pub_key, e.vote_b.sign_bytes(chain_id), e.vote_b.signature)
     _, per_item = bv.verify()

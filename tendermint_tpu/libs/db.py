@@ -109,8 +109,13 @@ class BufferedDB(DB):
         return len(self._sets) + len(self._dels)
 
     def flush(self) -> None:
+        from .trace import tracer
+
         if self._sets or self._dels:
-            self.base.write_batch(list(self._sets.items()), list(self._dels))
+            with tracer.span("window_flush", n_sets=len(self._sets),
+                             n_dels=len(self._dels)):
+                self.base.write_batch(list(self._sets.items()),
+                                      list(self._dels))
         self._sets.clear()
         self._dels.clear()
 

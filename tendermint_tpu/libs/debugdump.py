@@ -31,6 +31,12 @@ from typing import Optional
 
 _INSTALLED: dict = {}
 
+# how many trailing trace events land in the dump bundle (full rings are
+# 64k events — the tail is what describes the moments before the wedge)
+_TRACE_TAIL_EVENTS = 512
+# give the off-thread metrics render this long before the dump moves on
+_METRICS_RENDER_TIMEOUT_S = 2.0
+
 
 def write_dump(out_dir: str, node=None, loop=None) -> str:
     """Write stacks + node state under out_dir; returns the dump path."""
@@ -59,6 +65,50 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
                 except Exception as e:
                     f.write(f"  <stack unavailable: {e}>\n")
                 f.write("\n")
+
+    # metrics-registry snapshot: the same exposition text /metrics serves,
+    # but collected without the event loop — works when the RPC/metrics
+    # listener's loop is the thing that's wedged. render() takes the metric
+    # locks, and this handler may have interrupted the very frame holding
+    # one (signal handlers run on the main thread between bytecodes), so it
+    # runs on a helper thread with a join timeout instead of deadlocking
+    # the node harder than the wedge being diagnosed.
+    if node is not None and getattr(node, "metrics", None) is not None:
+        try:
+            import threading
+
+            path = os.path.join(out_dir, "metrics.prom")
+
+            def _render_and_write():
+                try:
+                    text = node.metrics.registry.render()
+                    with open(path, "w") as f:
+                        f.write(text)
+                except Exception:
+                    traceback.print_exc(file=sys.stderr)
+
+            t = threading.Thread(target=_render_and_write, daemon=True,
+                                 name="debugdump-metrics")
+            t.start()
+            t.join(_METRICS_RENDER_TIMEOUT_S)
+            # on timeout the daemon thread finishes the write (or not)
+            # once the interrupted frame releases its lock; nothing blocks
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+
+    # span-trace ring tail (libs/trace.py): the last hot-path spans before
+    # the wedge, loadable in Perfetto like a bench trace
+    try:
+        import json
+
+        from .trace import tracer
+
+        events = tracer.tail(_TRACE_TAIL_EVENTS)
+        if events:
+            with open(os.path.join(out_dir, "trace_tail.json"), "w") as f:
+                json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
 
     if node is not None:
         with open(os.path.join(out_dir, "node_state.txt"), "w") as f:

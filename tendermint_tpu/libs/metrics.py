@@ -32,7 +32,11 @@ class _Metric:
     def _fmt_labels(self, lv: Tuple[str, ...]) -> str:
         if not lv:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in zip(self.label_names, lv))
+        # sorted by label name — the SAME ordering Histogram bucket lines
+        # use, so one metric's series never mix two orderings and raw-text
+        # diffs/greps are deterministic (client_golang sorts identically)
+        inner = ",".join(f'{k}="{_escape_label(v)}"'
+                         for k, v in sorted(zip(self.label_names, lv)))
         return "{" + inner + "}"
 
     def render(self) -> List[str]:
@@ -43,6 +47,20 @@ class _Metric:
         for lv, val in items:
             out.append(f"{self.name}{self._fmt_labels(lv)} {_fmt(val)}")
         return out
+
+    def _check_arity(self, labels: Tuple) -> Tuple[str, ...]:
+        if len(labels) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected {len(self.label_names)} "
+                             f"labels, got {len(labels)}")
+        return tuple(str(v) for v in labels)
+
+    def value(self, *labels: str) -> float:
+        """Current value for a counter/gauge label set (0.0 if never
+        touched) — the seam bench/debug tooling reads instead of parsing
+        the exposition text."""
+        lv = self._check_arity(labels)
+        with self._lock:
+            return self._values.get(lv, 0.0)
 
 
 class _Bound:
@@ -66,6 +84,13 @@ def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the series line is unparseable
+    (exposition format spec, "Line format")."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class Counter(_Metric):
     kind = "counter"
 
@@ -76,8 +101,11 @@ class Counter(_Metric):
         with self._lock:
             self._values[lv] = self._values.get(lv, 0.0) + amount
 
-    def _set(self, lv, value):  # pragma: no cover - misuse guard
+    def _set(self, lv, value):  # misuse guard
         raise TypeError("counters only go up")
+
+    def _observe(self, lv, value):  # misuse guard
+        raise TypeError(f"{self.name}: observe() is only valid on histograms")
 
 
 class Gauge(_Metric):
@@ -96,6 +124,9 @@ class Gauge(_Metric):
     def _inc(self, lv: Tuple[str, ...], amount: float) -> None:
         with self._lock:
             self._values[lv] = self._values.get(lv, 0.0) + amount
+
+    def _observe(self, lv, value):  # misuse guard
+        raise TypeError(f"{self.name}: observe() is only valid on histograms")
 
 
 class Histogram(_Metric):
@@ -123,6 +154,33 @@ class Histogram(_Metric):
             self._sums[lv] = self._sums.get(lv, 0.0) + value
             self._totals[lv] = self._totals.get(lv, 0) + 1
 
+    def _set(self, lv, value):  # misuse guard
+        raise TypeError(f"{self.name}: set() is not valid on histograms")
+
+    def _inc(self, lv, amount):  # misuse guard
+        raise TypeError(f"{self.name}: inc() is not valid on histograms")
+
+    def value(self, *labels):  # misuse guard: _values is never populated
+        raise TypeError(f"{self.name}: histograms have no single value — "
+                        "use sum_value()/count_value()")
+
+    def sum_value(self, *labels: str) -> float:
+        lv = self._check_arity(labels)
+        with self._lock:
+            return self._sums.get(lv, 0.0)
+
+    def count_value(self, *labels: str) -> int:
+        lv = self._check_arity(labels)
+        with self._lock:
+            return self._totals.get(lv, 0)
+
+    def _bucket_labels(self, lv: Tuple[str, ...], le: str) -> str:
+        # deterministic: label names sorted, `le` always last (Prometheus
+        # only requires consistency, but scrapers and tests diff raw text)
+        pairs = sorted(zip(self.label_names, lv))
+        pairs.append(("le", le))
+        return ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
@@ -130,13 +188,9 @@ class Histogram(_Metric):
             items = sorted(self._counts.items())
             for lv, counts in items:
                 for b, c in zip(self.buckets, counts):
-                    labels = dict(zip(self.label_names, lv))
-                    labels["le"] = _fmt(b)
-                    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    inner = self._bucket_labels(lv, _fmt(b))
                     out.append(f"{self.name}_bucket{{{inner}}} {c}")
-                inf_labels = dict(zip(self.label_names, lv))
-                inf_labels["le"] = "+Inf"
-                inner = ",".join(f'{k}="{v}"' for k, v in inf_labels.items())
+                inner = self._bucket_labels(lv, "+Inf")
                 out.append(f"{self.name}_bucket{{{inner}}} {self._totals[lv]}")
                 out.append(f"{self.name}_sum{self._fmt_labels(lv)} "
                            f"{_fmt(self._sums[lv])}")
@@ -149,6 +203,7 @@ class Registry:
     def __init__(self, namespace: str = "tendermint"):
         self.namespace = namespace
         self._metrics: List[_Metric] = []
+        self._names: set = set()
         self._lock = threading.Lock()
 
     def counter(self, subsystem: str, name: str, help_: str,
@@ -171,6 +226,11 @@ class Registry:
 
     def _add(self, m):
         with self._lock:
+            if m.name in self._names:
+                # a silent duplicate double-renders the series and Prometheus
+                # rejects the whole scrape — fail at registration instead
+                raise ValueError(f"metric {m.name!r} already registered")
+            self._names.add(m.name)
             self._metrics.append(m)
         return m
 
@@ -279,6 +339,83 @@ class StateMetrics:
                                                0.05, 0.1, 0.25, 0.5, 1.0))
 
 
+class CryptoMetrics:
+    """The verification plane (no reference analog — the batched verifier
+    is this build's defining feature, so its routing must be observable:
+    batch-size and verify-latency distributions are the decisive tuning
+    inputs for committee-based consensus [arXiv:2302.00418], and
+    offload-vs-host routing counters the same for an offload engine
+    [arXiv:2112.02229])."""
+
+    #: batch sizes span 1 (evidence pairs) to 128k (10k-val windows)
+    BATCH_BUCKETS = (1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 131072)
+    LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+    def __init__(self, reg: Registry):
+        g, c, h = reg.gauge, reg.counter, reg.histogram
+        self.batch_size = h(
+            "crypto", "batch_size",
+            "Signatures per verification batch.", ["route", "plane"],
+            buckets=self.BATCH_BUCKETS)
+        self.verify_latency_seconds = h(
+            "crypto", "verify_latency_seconds",
+            "End-to-end batch verification latency.", ["route", "plane"],
+            buckets=self.LATENCY_BUCKETS)
+        self.routing_decisions_total = c(
+            "crypto", "routing_decisions_total",
+            "Batches routed per backend.", ["route", "plane"])
+        self.device_fallbacks_total = c(
+            "crypto", "device_fallbacks_total",
+            "Device-path batches re-verified on host.", ["reason"])
+        self.precomputed_hits_total = c(
+            "crypto", "precomputed_hits_total",
+            "Batches served entirely from precomputed verdicts.", ["plane"])
+        self.pad_waste_ratio = g(
+            "crypto", "pad_waste_ratio",
+            "Padded-slot fraction of the last device batch.", ["plane"])
+        self.vote_queue_depth = g(
+            "crypto", "vote_queue_depth",
+            "Votes pending in the micro-batcher at last flush.")
+        self.vote_flush_latency_seconds = h(
+            "crypto", "vote_flush_latency_seconds",
+            "Vote micro-batch flush latency.", ["route"],
+            buckets=self.LATENCY_BUCKETS)
+
+
+class BlocksyncMetrics:
+    """The fast-sync apply plane (blockchain/reactor.py 2-deep pipeline)."""
+
+    STAGE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5)
+
+    def __init__(self, reg: Registry):
+        g, c, h = reg.gauge, reg.counter, reg.histogram
+        self.stage_seconds = h(
+            "blocksync", "stage_seconds",
+            "Seconds per pipeline stage observation "
+            "(hash/verify per window, exec/store per block).", ["stage"],
+            buckets=self.STAGE_BUCKETS)
+        self.window_blocks = h(
+            "blocksync", "window_blocks",
+            "Blocks applied per verify window.",
+            buckets=(1, 2, 4, 8, 16, 32))
+        self.pipelined_windows_total = c(
+            "blocksync", "pipelined_windows_total",
+            "Windows whose stage A overlapped the previous apply.")
+        self.inline_windows_total = c(
+            "blocksync", "inline_windows_total",
+            "Windows verified inline (pipeline starved or first window).")
+        self.lookahead_stalls_total = c(
+            "blocksync", "lookahead_stalls_total",
+            "Iterations where the next window's blocks were not yet "
+            "downloaded when the lookahead wanted to start.")
+        self.stale_window_discards_total = c(
+            "blocksync", "stale_window_discards_total",
+            "Prepared windows discarded because the pool or validator set "
+            "moved underneath them.")
+
+
 class NodeMetrics:
     """All module metric sets over one registry (node/node.go:117
     MetricsProvider)."""
@@ -289,3 +426,5 @@ class NodeMetrics:
         self.mempool = MempoolMetrics(self.registry)
         self.p2p = P2PMetrics(self.registry)
         self.state = StateMetrics(self.registry)
+        self.crypto = CryptoMetrics(self.registry)
+        self.blocksync = BlocksyncMetrics(self.registry)

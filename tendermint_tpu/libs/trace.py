@@ -1,0 +1,148 @@
+"""Dependency-free span tracer with Chrome trace-event export.
+
+The reference leans on Go's pprof/runtime-trace for hot-path attribution;
+Python has no equivalent that survives a wedged loop AND is cheap enough to
+leave compiled into consensus-critical code. This is the minimal analog:
+
+    from tendermint_tpu.libs.trace import tracer
+    with tracer.span("verify_window", height=h, n_sigs=n):
+        ...
+
+records one complete ("X"-phase) Chrome trace event per span onto a bounded,
+thread-safe ring buffer. ``tracer.chrome_trace()`` / ``tracer.write(path)``
+export the standard trace-event JSON that https://ui.perfetto.dev and
+chrome://tracing load directly.
+
+Disabled (the default) the hot path pays one attribute check: call sites
+guard with ``if tracer.enabled`` or rely on :meth:`Tracer.span` returning a
+shared no-op context manager — no event dict, no span object, no timestamp
+read is allocated. ``bench.py --trace-out`` and tests enable it explicitly.
+
+The ring is a ``collections.deque(maxlen=...)``: appends are atomic under
+the GIL and old events fall off the front, so a long-running node can keep
+the tracer on and still bound memory — the dump (libs/debugdump.py) snapshots
+the tail of whatever survived.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 65536
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[Dict]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        ev = {
+            "name": self._name,
+            "ph": "X",
+            "ts": self._t0 * 1e6,  # trace-event timestamps are microseconds
+            "dur": (t1 - self._t0) * 1e6,
+            "pid": _PID,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if self._args:
+            ev["args"] = self._args
+        self._tracer._buf.append(ev)
+
+
+_PID = os.getpid()
+
+
+class Tracer:
+    """Bounded ring of Chrome trace events; safe to share across threads."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 enabled: bool = False):
+        self.capacity = capacity
+        self.enabled = enabled
+        self._buf: "collections.deque" = collections.deque(maxlen=capacity)
+
+    # -- control -------------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    # -- recording -----------------------------------------------------------
+
+    def span(self, name: str, **args) -> object:
+        """Context manager timing its body as one complete trace event.
+        When disabled, returns a shared no-op — nothing is allocated."""
+        if not self.enabled:
+            return _NOOP_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker ("i"-phase instant event)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "i", "s": "t",
+              "ts": time.perf_counter() * 1e6, "pid": _PID,
+              "tid": threading.get_ident() & 0x7FFFFFFF}
+        if args:
+            ev["args"] = args
+        self._buf.append(ev)
+
+    # -- export --------------------------------------------------------------
+
+    def events(self) -> List[dict]:
+        return list(self._buf)
+
+    def tail(self, n: int) -> List[dict]:
+        buf = self._buf
+        if n >= len(buf):
+            return list(buf)
+        return list(buf)[-n:]
+
+    def chrome_trace(self) -> dict:
+        """The standard trace-event container Perfetto/chrome://tracing
+        load: {"traceEvents": [...], "displayTimeUnit": "ms"}."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+#: process-global tracer, disabled by default; instrumented hot paths check
+#: ``tracer.enabled`` (one attribute load) before doing any tracing work
+tracer = Tracer()

@@ -157,7 +157,7 @@ def verify_chain_batched(trusted_lb, chain, trusting_period_s: float,
 
     # one verification per unique (step, commit idx, pubkey); both the
     # trusting and light checks of a step share commit signatures
-    bv = BatchVerifier()
+    bv = BatchVerifier(plane="light")
     positions = {}  # (step, commit idx) -> batch position
     for step, target in enumerate(chain):
         commit = target.signed_header.commit

@@ -202,6 +202,14 @@ class Node:
         from .p2p.conn.mconnection import set_p2p_metrics
 
         set_p2p_metrics(self.metrics.p2p)
+        # verification-plane metrics (crypto/batch.py module hook — covers
+        # BatchVerifier everywhere AND the vote micro-batcher) + the
+        # fast-sync pipeline's stage set, rebound onto the shared registry
+        # so /metrics serves tendermint_crypto_* and tendermint_blocksync_*
+        from .crypto.batch import set_crypto_metrics
+
+        set_crypto_metrics(self.metrics.crypto)
+        self.blockchain_reactor.metrics = self.metrics.blocksync
 
         # -- tx/block indexer (node.go:745 createAndStartIndexerService) ----
         self.indexer_service = None

@@ -97,6 +97,15 @@ class BlockExecutor:
 
     def apply_block(self, state: State, block_id: BlockID, block: Block) -> Tuple[State, int]:
         """Returns (new_state, retain_height)."""
+        from ..libs.trace import tracer as _tracer
+
+        # exception-safe span: a rejected block must still leave its event
+        with _tracer.span("apply_block", height=block.header.height,
+                          n_txs=len(block.data.txs)):
+            return self._apply_block_inner(state, block_id, block)
+
+    def _apply_block_inner(self, state: State, block_id: BlockID,
+                           block: Block) -> Tuple[State, int]:
         import time as _time
 
         from ..libs.fail import fail_point

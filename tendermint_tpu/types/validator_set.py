@@ -363,7 +363,7 @@ class ValidatorSet:
         examined (validator_set.go:722) — the replay preserves that."""
         self._check_commit_shape(commit, height, block_id)
         idxs = [i for i, cs in enumerate(commit.signatures) if cs.for_block()]
-        ok = self._batch_verify(chain_id, commit, idxs)
+        ok = self._batch_verify(chain_id, commit, idxs, plane="light")
         tallied = 0
         needed = self.total_voting_power() * 2 // 3
         for pos, idx in enumerate(idxs):
@@ -396,7 +396,8 @@ class ValidatorSet:
             if val is not None:
                 cand.append((idx, val_idx, val))
         ok = self._batch_verify(chain_id, commit, [c[0] for c in cand],
-                                pubkeys=[c[2].pub_key for c in cand])
+                                pubkeys=[c[2].pub_key for c in cand],
+                                plane="light")
         tallied = 0
         seen = {}
         for pos, (idx, val_idx, val) in enumerate(cand):
@@ -421,10 +422,11 @@ class ValidatorSet:
             )
 
     def _batch_verify(self, chain_id: str, commit, idxs: Sequence[int],
-                      pubkeys: Optional[Sequence] = None) -> List[bool]:
+                      pubkeys: Optional[Sequence] = None,
+                      plane: str = "votes") -> List[bool]:
         if not idxs:
             return []
-        bv = BatchVerifier()
+        bv = BatchVerifier(plane=plane)
         # amortized sign-bytes: one shared-field encode for the whole commit
         # instead of len(idxs) canonical encodes (the host-side cost floor)
         sb = (commit.vote_sign_bytes_all(chain_id) if len(idxs) > 32
@@ -478,7 +480,7 @@ def verify_commit_light_batched(
 
     Entries: (val_set, chain_id, block_id, height, commit).
     """
-    bv = BatchVerifier()
+    bv = BatchVerifier(plane="light")
     slices: List[Tuple[int, List[int]]] = []  # (batch offset, candidate idxs)
     shape_errors: List[Optional[Exception]] = []
     off = 0
@@ -535,7 +537,7 @@ def verify_commit_light_trusting_batched(
     Per-entry outcome is None (ok) or the exact exception
     verify_commit_light_trusting would have raised.
     """
-    bv = BatchVerifier()
+    bv = BatchVerifier(plane="light")
     slices: List[Tuple[int, List[Tuple[int, int, Validator]]]] = []
     pre_errors: List[Optional[Exception]] = []
     needed_list: List[int] = []

@@ -1,0 +1,134 @@
+"""Prometheus text exposition format + registry hygiene
+(libs/metrics.py; reference libs/prometheus text format spec).
+
+A malformed exposition line poisons the WHOLE scrape — Prometheus rejects
+the body — so escaping and determinism are correctness, not cosmetics.
+"""
+
+import pytest
+
+from tendermint_tpu.libs.metrics import (
+    BlocksyncMetrics,
+    CryptoMetrics,
+    Gauge,
+    Histogram,
+    NodeMetrics,
+    Registry,
+)
+
+
+def test_histogram_bucket_sum_count_lines():
+    reg = Registry("t")
+    h = reg.histogram("sub", "lat", "help.", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    lines = h.render()
+    assert "# HELP t_sub_lat help." in lines
+    assert "# TYPE t_sub_lat histogram" in lines
+    assert 't_sub_lat_bucket{le="0.1"} 1' in lines
+    assert 't_sub_lat_bucket{le="1"} 2' in lines
+    assert 't_sub_lat_bucket{le="+Inf"} 3' in lines
+    assert "t_sub_lat_count 3" in lines
+    sum_line = [l for l in lines if l.startswith("t_sub_lat_sum")][0]
+    assert abs(float(sum_line.split()[-1]) - 5.55) < 1e-9
+
+
+def test_histogram_labeled_buckets_le_last_sorted():
+    reg = Registry("t")
+    h = reg.histogram("sub", "lat", "help.", labels=["route", "plane"],
+                      buckets=(1.0,))
+    h.labels("device", "light").observe(0.5)
+    lines = h.render()
+    # label names sorted (plane < route), le ALWAYS last — deterministically
+    assert 't_sub_lat_bucket{plane="light",route="device",le="1"} 1' in lines
+    assert ('t_sub_lat_bucket{plane="light",route="device",le="+Inf"} 1'
+            in lines)
+    # sum/count use the same sorted order (one metric, one ordering)
+    assert 't_sub_lat_count{plane="light",route="device"} 1' in lines
+
+
+def test_label_value_escaping():
+    reg = Registry("t")
+    c = reg.counter("sub", "hits", "help.", labels=["who"])
+    c.labels('ba"ck\\slash\nnl').inc()
+    line = [l for l in c.render() if not l.startswith("#")][0]
+    assert line == 't_sub_hits{who="ba\\"ck\\\\slash\\nnl"} 1'
+    h = reg.histogram("sub", "lat", "help.", labels=["who"], buckets=(1.0,))
+    h.labels('q"v').observe(0.5)
+    bucket = [l for l in h.render() if "_bucket" in l][0]
+    assert 'who="q\\"v"' in bucket
+
+
+def test_duplicate_registration_raises():
+    reg = Registry("t")
+    reg.counter("sub", "x", "first.")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("sub", "x", "second.")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("sub", "x", "as another type either.")
+    # distinct fq names still fine
+    reg.counter("sub2", "x", "other subsystem.")
+
+
+def test_misuse_guards_raise_typeerror():
+    reg = Registry("t")
+    c = reg.counter("sub", "c", "help.", labels=["a"])
+    g = reg.gauge("sub", "g", "help.", labels=["a"])
+    h = reg.histogram("sub", "h", "help.", labels=["a"])
+    with pytest.raises(TypeError):
+        c.labels("x").observe(1.0)
+    with pytest.raises(TypeError):
+        c.labels("x").set(1.0)
+    with pytest.raises(TypeError):
+        g.labels("x").observe(1.0)
+    with pytest.raises(TypeError):
+        h.labels("x").set(1.0)
+    with pytest.raises(TypeError):
+        h.labels("x").inc()
+    with pytest.raises(TypeError):
+        h.value("x")  # histograms expose sum_value()/count_value() instead
+    with pytest.raises(ValueError):
+        c.value()  # accessor arity is checked like labels()
+    with pytest.raises(ValueError):
+        h.sum_value("x", "extra")
+    # the valid operations still work after the failed misuse
+    c.labels("x").inc()
+    g.labels("x").set(2.0)
+    h.labels("x").observe(0.1)
+    assert c.value("x") == 1.0
+    assert g.value("x") == 2.0
+    assert h.count_value("x") == 1 and h.sum_value("x") == 0.1
+
+
+def test_node_metrics_includes_crypto_and_blocksync_sets():
+    nm = NodeMetrics("tendermint")
+    assert isinstance(nm.crypto, CryptoMetrics)
+    assert isinstance(nm.blocksync, BlocksyncMetrics)
+    nm.crypto.routing_decisions_total.labels("device", "light").inc()
+    nm.crypto.batch_size.labels("device", "light").observe(1024)
+    nm.blocksync.stage_seconds.labels("verify").observe(0.01)
+    text = nm.registry.render()
+    assert ('tendermint_crypto_routing_decisions_total'
+            '{plane="light",route="device"} 1') in text
+    assert ('tendermint_crypto_batch_size_bucket'
+            '{plane="light",route="device",le="1024"} 1') in text
+    assert "# TYPE tendermint_blocksync_stage_seconds histogram" in text
+    assert 'tendermint_blocksync_stage_seconds_count{stage="verify"} 1' in text
+    # one shared registry: a second NodeMetrics over a fresh registry does
+    # not collide, but re-registering on the same one would
+    with pytest.raises(ValueError):
+        CryptoMetrics(nm.registry)
+
+
+def test_gauge_still_supports_inc_and_set():
+    g = Gauge("g", "help.")
+    g.set(5)
+    g.inc(2)
+    assert g.value() == 7.0
+    assert "g 7" in g.render()
+
+
+def test_histogram_render_empty_is_header_only():
+    h = Histogram("h", "help.")
+    assert h.render() == ["# HELP h help.", "# TYPE h histogram"]

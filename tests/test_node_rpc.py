@@ -239,6 +239,11 @@ def test_metrics_endpoint(tmp_path):
             assert "tendermint_consensus_validators 1" in text
             assert "tendermint_state_block_processing_time_count" in text
             assert "tendermint_consensus_block_interval_seconds_bucket" in text
+            # the verification/apply-plane sets are registered even when the
+            # series are idle (this node self-proposes, it doesn't fast-sync)
+            assert "# TYPE tendermint_crypto_batch_size histogram" in text
+            assert ("# TYPE tendermint_blocksync_stage_seconds histogram"
+                    in text)
         finally:
             await node.stop()
     asyncio.run(run())

@@ -1,0 +1,89 @@
+"""Span tracer (libs/trace.py): ring bounding, disabled-path cost, Chrome
+trace-event export shape."""
+
+import json
+import threading
+
+from tendermint_tpu.libs.trace import Tracer, _NOOP_SPAN
+
+
+def test_disabled_tracer_is_noop_singleton():
+    t = Tracer(capacity=8, enabled=False)
+    s1 = t.span("a", height=1)
+    s2 = t.span("b")
+    # zero-allocation path: the SAME shared object every call, no state
+    assert s1 is s2 is _NOOP_SPAN
+    with s1:
+        pass
+    t.instant("c")
+    assert t.events() == []
+
+
+def test_span_records_complete_event():
+    t = Tracer(capacity=8, enabled=True)
+    with t.span("verify_window", height=7, n=3):
+        pass
+    (ev,) = t.events()
+    assert ev["name"] == "verify_window"
+    assert ev["ph"] == "X"
+    assert ev["dur"] >= 0
+    assert ev["ts"] > 0
+    assert ev["args"] == {"height": 7, "n": 3}
+    assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+
+
+def test_ring_buffer_bounded():
+    t = Tracer(capacity=16, enabled=True)
+    for i in range(100):
+        with t.span("s", i=i):
+            pass
+    evs = t.events()
+    assert len(evs) == 16
+    # oldest fell off the front: only the newest 16 survive
+    assert [e["args"]["i"] for e in evs] == list(range(84, 100))
+    assert [e["args"]["i"] for e in t.tail(4)] == [96, 97, 98, 99]
+
+
+def test_chrome_trace_export_roundtrip(tmp_path):
+    t = Tracer(enabled=True)
+    with t.span("apply_block", height=1):
+        pass
+    t.instant("vote_flush", n=5)
+    path = t.write(str(tmp_path / "trace.json"))
+    data = json.loads(open(path).read())
+    assert data["displayTimeUnit"] == "ms"
+    names = [e["name"] for e in data["traceEvents"]]
+    assert names == ["apply_block", "vote_flush"]
+    inst = data["traceEvents"][1]
+    assert inst["ph"] == "i" and inst["s"] == "t"
+    # event timestamps are monotonic within a thread
+    assert data["traceEvents"][0]["ts"] <= inst["ts"]
+
+
+def test_enable_disable_clear():
+    t = Tracer(enabled=False)
+    t.enable()
+    with t.span("a"):
+        pass
+    t.disable()
+    with t.span("b"):
+        pass
+    assert [e["name"] for e in t.events()] == ["a"]
+    t.clear()
+    assert t.events() == []
+
+
+def test_threaded_appends_all_land():
+    t = Tracer(capacity=4096, enabled=True)
+
+    def work():
+        for i in range(200):
+            with t.span("w", i=i):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(t.events()) == 800

@@ -1,0 +1,133 @@
+"""Summarize a Chrome trace-event JSON (libs/trace.py / bench.py --trace-out).
+
+Prints per-span count / total / p50 / p99 so a bench trace answers "where
+did the window go" without opening Perfetto:
+
+    python tools/trace_summary.py /tmp/bench-trace.json
+    python tools/trace_summary.py --json /tmp/bench-trace.json   # machine-readable
+    python tools/trace_summary.py --self-test                    # CI guard
+
+Dependency-free on purpose (stdlib only, no package import): it must run
+against a dump bundle on a box that can't import jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    """Accept both the {"traceEvents": [...]} container and a bare event
+    array (both are valid Chrome trace JSON)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        events = data.get("traceEvents", [])
+    elif isinstance(data, list):
+        events = data
+    else:
+        raise ValueError(f"{path}: not a trace-event JSON")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    return [e for e in events if isinstance(e, dict) and e.get("name")]
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (no numpy)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(events: List[dict]) -> Dict[str, dict]:
+    """name -> {count, total_us, p50_us, p99_us}; complete ("X") events
+    contribute their dur, instants count with zero duration."""
+    durs: Dict[str, List[float]] = {}
+    for e in events:
+        durs.setdefault(e["name"], []).append(float(e.get("dur", 0.0)))
+    out: Dict[str, dict] = {}
+    for name, vals in sorted(durs.items()):
+        vals.sort()
+        out[name] = {
+            "count": len(vals),
+            "total_us": round(sum(vals), 1),
+            "p50_us": round(_percentile(vals, 0.50), 1),
+            "p99_us": round(_percentile(vals, 0.99), 1),
+        }
+    return out
+
+
+def render(summary: Dict[str, dict]) -> str:
+    if not summary:
+        return "(no events)"
+    name_w = max(len("span"), max(len(n) for n in summary))
+    lines = [f"{'span':<{name_w}}  {'count':>7}  {'total_ms':>10}  "
+             f"{'p50_us':>9}  {'p99_us':>9}"]
+    for name, s in summary.items():
+        lines.append(f"{name:<{name_w}}  {s['count']:>7}  "
+                     f"{s['total_us'] / 1000.0:>10.2f}  "
+                     f"{s['p50_us']:>9.1f}  {s['p99_us']:>9.1f}")
+    return "\n".join(lines)
+
+
+def self_test() -> int:
+    """Round-trip a synthetic trace through a temp file: the format this
+    tool parses is exactly what libs/trace.py and bench.py emit. Returns 0
+    on success (CI runs this under pytest so the tool can't rot)."""
+    import os
+    import tempfile
+
+    events = []
+    t = 1000.0
+    for i in range(8):
+        for name, dur in (("verify_window", 500.0 + i), ("apply_window", 900.0),
+                          ("apply_block", 55.0), ("window_flush", 20.0)):
+            events.append({"name": name, "ph": "X", "ts": t, "dur": dur,
+                           "pid": 1, "tid": 1, "args": {"i": i}})
+            t += dur
+    events.append({"name": "vote_flush", "ph": "i", "s": "t", "ts": t,
+                   "pid": 1, "tid": 1})
+    fd, path = tempfile.mkstemp(suffix=".json")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        summary = summarize(load_events(path))
+    finally:
+        os.unlink(path)
+    assert len(summary) == 5, summary
+    assert summary["apply_window"]["count"] == 8
+    assert summary["apply_window"]["p50_us"] == 900.0
+    assert summary["vote_flush"]["total_us"] == 0.0
+    assert summary["verify_window"]["p99_us"] >= summary["verify_window"]["p50_us"]
+    print("trace_summary self-test OK "
+          f"({len(summary)} spans, {sum(s['count'] for s in summary.values())}"
+          " events)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace", nargs="?", help="Chrome trace-event JSON path")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of a table")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in round-trip check and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.trace:
+        ap.error("trace path required (or --self-test)")
+    summary = summarize(load_events(args.trace))
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
