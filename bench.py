@@ -515,14 +515,40 @@ def bench_fast_sync_pipeline():
           rate / host_rate)
 
 
+#: previous round's localnet p50 commit latency (BENCH_r05) — the anchor the
+#: live-plane work is measured against (this PR's event-driven gossip + WAL
+#: group commit target exactly this number)
+R05_LOCALNET_P50_S = 1.121
+
+
+def _prom_sum(text: str, name: str) -> float:
+    """Sum a Prometheus series across its label sets (text exposition)."""
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest[:1] not in ("{", " "):
+            continue  # e.g. foo_sum when asked for foo
+        try:
+            total += float(line.rsplit(" ", 1)[1])
+        except ValueError:
+            pass
+    return total
+
+
 def bench_localnet():
     """Config #4: 4-node localnet over TCP (kvstore app), consensus reactor
     end-to-end. Measures blocks/min across the net and broadcast_tx_commit
-    latency. Baseline anchor: reference 200-node QA testnet 19.5 blocks/min
-    (docs/qa/v034/README.md:141-142)."""
+    latency, plus the live-plane breakdown (gossip wakeups vs polls,
+    encode-cache hit rate, WAL records-per-fsync) scraped from /metrics and
+    a per-height span breakdown from the nodes' shutdown traces. Baseline
+    anchors: reference 200-node QA testnet 19.5 blocks/min
+    (docs/qa/v034/README.md:141-142); p50 latency vs BENCH_r05's 1.121 s."""
     import shutil
     import signal
     import subprocess
+    import sys
     import tempfile
     import urllib.request
 
@@ -535,6 +561,7 @@ def bench_localnet():
             return json.loads(r.read())
 
     procs = []
+    per_height = None
     try:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         # CPU-pinned subprocesses (init included) must not touch the TPU
@@ -542,10 +569,13 @@ def bench_localnet():
         # (sitecustomize) and a slow relay would stall startup past the
         # liveness deadline (the e2e runner drops this var the same way)
         env.pop("PALLAS_AXON_POOL_IPS", None)
+        # each node runs under the span tracer and writes a Chrome trace on
+        # graceful shutdown — the per-height live-plane attribution input
+        env["TMTPU_TRACE_OUT"] = os.path.join(root, "trace")
         subprocess.run(
             ["python", "-m", "tendermint_tpu.cmd", "testnet", "--v", "4",
              "--output-dir", root, "--chain-id", "bench-e2e",
-             "--starting-port", str(port0)],
+             "--starting-port", str(port0), "--prometheus"],
             check=True, capture_output=True, timeout=120, env=env)
         for i in range(4):
             procs.append(subprocess.Popen(
@@ -589,10 +619,43 @@ def bench_localnet():
         end_h = int(rpc(port0 + 1, "status")
                     ["result"]["sync_info"]["latest_block_height"])
         blocks_per_min = (end_h - start_h) / elapsed * 60.0
-        _emit("localnet_4node_tx_commit_latency_p50", float(np.median(tx_lat)),
-              "s", 0.0)
+        p50 = float(np.median(tx_lat))
+        _emit("localnet_4node_tx_commit_latency_p50", p50, "s",
+              R05_LOCALNET_P50_S / p50, r05_p50_s=R05_LOCALNET_P50_S)
         _emit("localnet_4node_blocks_per_min", blocks_per_min, "blocks/min",
               blocks_per_min / 19.5)
+
+        # live-plane breakdown from the RPC node's (node0's) /metrics —
+        # testnet --prometheus serves node i on starting_port+2v+i (past the
+        # p2p/rpc port block), and every rpc()/tx call above hit node0 (rpc
+        # port port0+1)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port0 + 8}/metrics", timeout=10) as r:
+                mtext = r.read().decode()
+            pre = "tendermint_consensus_"
+            wakeups = _prom_sum(mtext, pre + "gossip_wakeups_total")
+            polls = _prom_sum(mtext, pre + "gossip_polls_total")
+            ehits = _prom_sum(mtext, pre + "encode_cache_hits_total")
+            emiss = _prom_sum(mtext, pre + "encode_cache_misses_total")
+            fsyncs = _prom_sum(mtext, pre + "wal_fsyncs_total")
+            rec_sum = _prom_sum(mtext, pre + "wal_records_per_fsync_sum")
+            rec_cnt = _prom_sum(mtext, pre + "wal_records_per_fsync_count")
+            fsync_s = _prom_sum(mtext, pre + "wal_fsync_seconds_sum")
+            _emit("localnet_4node_live_plane_breakdown",
+                  wakeups / max(1.0, wakeups + polls), "ratio", 0.0,
+                  gossip_wakeups=int(wakeups), gossip_polls=int(polls),
+                  encode_cache_hits=int(ehits),
+                  encode_cache_misses=int(emiss),
+                  encode_cache_hit_ratio=round(
+                      ehits / max(1.0, ehits + emiss), 3),
+                  wal_fsyncs=int(fsyncs),
+                  wal_records_per_fsync_avg=round(
+                      rec_sum / max(1.0, rec_cnt), 2),
+                  wal_fsync_seconds_total=round(fsync_s, 4))
+        except Exception as e:
+            _emit("localnet_4node_live_plane_breakdown", 0.0, "error", 0.0,
+                  error=f"{type(e).__name__}: {e}")
     finally:
         for p in procs:
             try:
@@ -604,7 +667,37 @@ def bench_localnet():
                 p.wait(timeout=10)
             except Exception:
                 p.kill()
+        # per-height live-plane attribution from the nodes' shutdown traces
+        # (gossip wait vs WAL sync vs apply per height) — best-effort
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+            try:
+                from trace_summary import by_height, load_events
+            finally:
+                sys.path.pop(0)
+            merged = {}
+            for name in sorted(os.listdir(root)):
+                if not (name.startswith("trace-") and name.endswith(".json")):
+                    continue
+                for h, per in by_height(
+                        load_events(os.path.join(root, name))).items():
+                    tgt = merged.setdefault(h, {})
+                    for span, us in per.items():
+                        tgt[span] = tgt.get(span, 0.0) + us
+            if merged:
+                spans = sorted({s for per in merged.values() for s in per})
+                n_h = len(merged)
+                mean_ms = {s: round(sum(per.get(s, 0.0)
+                                        for per in merged.values())
+                                    / n_h / 1000.0, 3) for s in spans}
+                per_height = {"n_heights": n_h, "mean_ms_per_height": mean_ms}
+        except Exception:
+            per_height = None
         shutil.rmtree(root, ignore_errors=True)
+    if per_height is not None:
+        _emit("localnet_4node_per_height_breakdown",
+              per_height["mean_ms_per_height"].get("gossip_idle", 0.0),
+              "ms/height", 0.0, **per_height)
 
 
 def bench_verify_commit_10k():

@@ -97,6 +97,16 @@ def cmd_start(args) -> int:
     cfg.validate_basic()
     node = Node.default(cfg)
 
+    # TMTPU_TRACE_OUT=<prefix>: run the whole node under the span tracer and
+    # write <prefix>-<pid>.json (Chrome trace-event JSON) on shutdown, so a
+    # localnet's per-height live-plane breakdown (gossip wait / WAL sync /
+    # apply) is recoverable with tools/trace_summary.py --by-height
+    trace_prefix = os.environ.get("TMTPU_TRACE_OUT")
+    if trace_prefix:
+        from .libs.trace import tracer as _tracer
+
+        _tracer.enable()
+
     async def run():
         # SIGUSR1 -> synchronous in-process dump of thread stacks, asyncio
         # task stacks, round state and peer table — works even when the
@@ -127,6 +137,12 @@ def cmd_start(args) -> int:
         print("shutting down...")
         fatal.cancel()
         await node.stop()
+        if trace_prefix:
+            from .libs.trace import tracer as _tracer
+
+            path = f"{trace_prefix}-{os.getpid()}.json"
+            _tracer.write(path)
+            print(f"wrote span trace {path}")
 
     asyncio.run(run())
     return 0
@@ -150,6 +166,12 @@ def cmd_testnet(args) -> int:
         cfg.base.moniker = f"node{i}"
         cfg.p2p.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        if getattr(args, "prometheus", False):
+            # metrics ports start right after the nodes' p2p/rpc block
+            # ([starting_port, starting_port + 2v)), collision-free for any v
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = (
+                f"tcp://127.0.0.1:{args.starting_port + 2 * args.v + i}")
         os.makedirs(os.path.join(home, cfgmod.CONFIG_DIR), exist_ok=True)
         os.makedirs(os.path.join(home, cfgmod.DATA_DIR), exist_ok=True)
         pv = FilePV.generate(cfg.priv_validator_key_file(),
@@ -524,6 +546,8 @@ def main(argv=None) -> int:
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", dest="starting_port", type=int,
                     default=26656)
+    sp.add_argument("--prometheus", action="store_true",
+                    help="serve /metrics on starting_port+2v+i per node")
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("light", help="verifying light-client proxy")

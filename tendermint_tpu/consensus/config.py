@@ -20,9 +20,20 @@ class ConsensusConfig:
     create_empty_blocks_interval: float = 0.0
     double_sign_check_height: int = 0
     wal_file: str = ""
-    # gossip sleeps (reactor)
+    # gossip sleeps (reactor). With event wakeups on, the sleep is only the
+    # FALLBACK cap on how stale a gossip iteration can go without a signal —
+    # state transitions, new parts, and new votes wake the routines directly.
     peer_gossip_sleep_duration: float = 0.1
     peer_query_maj23_sleep_duration: float = 2.0
+    peer_gossip_event_wakeups: bool = True
+    # WAL group commit: the receive loop drains up to max_batch queued
+    # messages, logs them all, and fsyncs ONCE when any is our own —
+    # records and ordering identical to per-record sync, fewer disk syncs.
+    wal_group_commit: bool = True
+    wal_group_commit_max_batch: int = 128
+    # fsync deadline for grouped batches with only peer records (which the
+    # reference never syncs at all; this bounds the async tail's lag)
+    wal_sync_deadline: float = 0.05
 
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

@@ -1,11 +1,17 @@
 """Consensus wire messages (reference consensus/msgs.go;
 proto/tendermint/consensus/types.proto Message oneof, fields 1-9).
+
+``WireEncodeCache`` deduplicates ``encode_msg`` work across the reactor's
+per-peer gossip routines: the same vote or block part is sent to every peer
+and re-considered every loop iteration, but its wire bytes depend only on
+content, so one encode serves all sends.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional, Tuple
 
 from ..libs import protowire as pw
 from ..libs.bits import BitArray
@@ -146,6 +152,75 @@ def encode_msg(msg) -> bytes:
     else:
         raise ValueError(f"unknown consensus message {type(msg)}")
     return w.finish()
+
+
+class WireEncodeCache:
+    """Content-keyed cache of ``encode_msg`` outputs, shared across peers
+    and gossip-loop iterations.
+
+    Keys carry full message identity — (height, round, part-set-header
+    hash, part index) for block parts, the signature for votes and
+    proposals (a signature pins the exact signed content, so even
+    equivocating votes at the same H/R/type/index key separately) — so a
+    stale entry can never serve bytes for different content. Eviction is
+    therefore pure memory management: LRU-bounded, plus the reactor
+    explicitly prunes heights that fell out of the live gossip window on
+    every height advance.
+    """
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self.stats = {"hits": 0, "misses": 0}
+        self.metrics = None  # ConsensusMetrics, wired by the node
+
+    def get(self, kind: str, height: int, key: Tuple,
+            build: Callable[[], bytes]) -> bytes:
+        k = (kind, height, key)
+        buf = self._entries.get(k)
+        m = self.metrics
+        if buf is not None:
+            self._entries.move_to_end(k)
+            self.stats["hits"] += 1
+            if m is not None:
+                m.encode_cache_hits_total.labels(kind).inc()
+            return buf
+        buf = build()
+        self.stats["misses"] += 1
+        if m is not None:
+            m.encode_cache_misses_total.labels(kind).inc()
+        self._entries[k] = buf
+        if len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+        return buf
+
+    def vote(self, vote) -> bytes:
+        return self.get(
+            "vote", vote.height,
+            (vote.round, int(vote.type), vote.validator_index, vote.signature),
+            lambda: encode_msg(VoteMessageWire(vote)))
+
+    def block_part(self, height: int, round_: int, psh_hash: bytes,
+                   part) -> bytes:
+        return self.get(
+            "block_part", height, (round_, psh_hash, part.index),
+            lambda: encode_msg(BlockPartMessageWire(height, round_, part)))
+
+    def proposal(self, proposal) -> bytes:
+        return self.get(
+            "proposal", proposal.height, (proposal.round, proposal.signature),
+            lambda: encode_msg(ProposalMessageWire(proposal)))
+
+    def prune_below(self, height: int) -> int:
+        """Drop entries below `height` (called on height advance; lagging
+        catchup peers below the cutoff re-encode — LRU already bounds them)."""
+        dead = [k for k in self._entries if k[1] < height]
+        for k in dead:
+            del self._entries[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._entries)
 
 
 def decode_msg(data: bytes):

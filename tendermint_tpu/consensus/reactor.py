@@ -12,6 +12,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..libs.bits import BitArray
+from ..libs.trace import tracer
 from ..p2p import (
     DATA_CHANNEL,
     STATE_CHANNEL,
@@ -31,6 +32,7 @@ from .msgs import (
     VoteMessageWire,
     VoteSetBitsMessage,
     VoteSetMaj23Message,
+    WireEncodeCache,
     decode_msg,
     encode_msg,
 )
@@ -41,6 +43,34 @@ logger = logging.getLogger("tmtpu.cs.reactor")
 
 # cap on detached preverify-and-forward tasks before peer backpressure kicks in
 MAX_INFLIGHT_PREVERIFY = 1024
+
+
+class _Waker:
+    """Level-triggered wakeup for one gossip routine.
+
+    ``wake()`` sets the event; ``wait()`` returns True as soon as any wake
+    since the last wait fired (including during the routine's preceding
+    work burst — no lost wakeups), or False when the fallback sleep cap
+    expired with no signal. The configured peer_gossip_sleep_duration thus
+    becomes an upper bound on gossip staleness instead of its clock.
+    """
+
+    __slots__ = ("_event",)
+
+    def __init__(self):
+        self._event = asyncio.Event()
+
+    def wake(self) -> None:
+        self._event.set()
+
+    async def wait(self, timeout: float) -> bool:
+        if not self._event.is_set():
+            try:
+                await asyncio.wait_for(self._event.wait(), timeout)
+            except asyncio.TimeoutError:
+                return False
+        self._event.clear()
+        return True
 
 
 class PeerRoundState:
@@ -291,11 +321,25 @@ class ConsensusReactor(Reactor):
         # strong refs to detached preverify-and-forward tasks (the loop keeps
         # only weak refs; a GC'd task would drop the vote silently)
         self._inflight: set = set()
+        # event-driven gossip: per-peer wakers for the data/votes routines,
+        # signaled on round-state transitions, new proposal data, and new
+        # votes (and on inbound peer-state changes for that peer)
+        self._wakers: Dict[str, Dict[str, _Waker]] = {}
+        # one encode per message content, shared across peers and iterations
+        self._encode_cache = WireEncodeCache()
+        self._prune_height = 0
         # subscribe to internal state events for broadcasts
         cs.new_round_step_listeners.append(self._broadcast_new_round_step)
         cs.valid_block_listeners.append(self._broadcast_new_valid_block)
         cs.vote_listeners.append(self._broadcast_has_vote)
         cs.equivocation_listeners.append(self._broadcast_vote_directly)
+        cs.proposal_data_listeners.append(self._wake_data_routines)
+
+    def set_metrics(self, metrics) -> None:
+        """Wire ConsensusMetrics into the reactor-side hot paths. The gossip
+        wakeup/poll counters read ``cs.metrics`` directly; the encode cache
+        keeps its own hook because it has no cs reference."""
+        self._encode_cache.metrics = metrics
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [
@@ -313,6 +357,8 @@ class ConsensusReactor(Reactor):
 
     async def add_peer(self, peer: Peer) -> None:
         ps = self._peer_states[peer.id]
+        if self.cs.config.peer_gossip_event_wakeups:
+            self._wakers[peer.id] = {"data": _Waker(), "votes": _Waker()}
         tasks = [
             asyncio.create_task(self._gossip_data_routine(peer, ps)),
             asyncio.create_task(self._gossip_votes_routine(peer, ps)),
@@ -326,12 +372,52 @@ class ConsensusReactor(Reactor):
         for t in self._gossip_tasks.pop(peer.id, []):
             t.cancel()
         self._peer_states.pop(peer.id, None)
+        self._wakers.pop(peer.id, None)
 
     async def stop(self) -> None:
         for tasks in self._gossip_tasks.values():
             for t in tasks:
                 t.cancel()
         self._gossip_tasks.clear()
+        self._wakers.clear()
+
+    # -- gossip wakeups ----------------------------------------------------
+
+    def _wake_gossip(self, routine: Optional[str] = None) -> None:
+        """Wake every peer's gossip routines (or just one routine kind)."""
+        for wakers in self._wakers.values():
+            if routine is None:
+                for w in wakers.values():
+                    w.wake()
+            else:
+                w = wakers.get(routine)
+                if w is not None:
+                    w.wake()
+
+    def _wake_data_routines(self) -> None:
+        self._wake_gossip("data")
+
+    def _wake_peer(self, peer_id: str) -> None:
+        """An inbound message changed what this peer is known to have."""
+        for w in self._wakers.get(peer_id, {}).values():
+            w.wake()
+
+    async def _gossip_idle(self, waker: Optional[_Waker], sleep: float,
+                           routine: str) -> None:
+        """Idle until an event wakeup or the fallback sleep cap."""
+        if waker is None:
+            await asyncio.sleep(sleep)
+            return
+        if tracer.enabled:
+            with tracer.span("gossip_idle", routine=routine,
+                             height=self.cs.rs.height):
+                woke = await waker.wait(sleep)
+        else:
+            woke = await waker.wait(sleep)
+        m = self.cs.metrics
+        if m is not None:
+            (m.gossip_wakeups_total if woke
+             else m.gossip_polls_total).labels(routine).inc()
 
     # -- switch-to-consensus (reactor.go:108) ------------------------------
 
@@ -354,7 +440,7 @@ class ConsensusReactor(Reactor):
         """Maverick support: push a (possibly equivocating) vote to every
         peer on the vote channel, bypassing vote-set gossip."""
         if self.switch is not None:
-            self.switch.broadcast(VOTE_CHANNEL, encode_msg(VoteMessageWire(vote)))
+            self.switch.broadcast(VOTE_CHANNEL, self._encode_cache.vote(vote))
 
     async def _preverify_and_forward(self, vote, peer_id: str) -> None:
         """Pre-verify then enqueue to the state machine. Vote delivery order
@@ -398,8 +484,11 @@ class ConsensusReactor(Reactor):
             if isinstance(msg, NewRoundStepMessage):
                 _validate_nrs(msg, self.cs.state.initial_height)
                 ps.apply_new_round_step(msg)
+                # the peer moved: what we can usefully send it changed
+                self._wake_peer(peer.id)
             elif isinstance(msg, NewValidBlockMessage):
                 ps.apply_new_valid_block(msg)
+                self._wake_peer(peer.id)
             elif isinstance(msg, HasVoteMessage):
                 ps.apply_has_vote(msg)
             elif isinstance(msg, VoteSetMaj23Message):
@@ -430,6 +519,7 @@ class ConsensusReactor(Reactor):
                 await self.cs.add_peer_msg(ProposalMessage(msg.proposal), peer.id)
             elif isinstance(msg, ProposalPOLMessage):
                 ps.apply_proposal_pol(msg)
+                self._wake_peer(peer.id)
             elif isinstance(msg, BlockPartMessageWire):
                 ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
                 await self.cs.add_peer_msg(
@@ -485,10 +575,17 @@ class ConsensusReactor(Reactor):
         )
 
     def _broadcast_new_round_step(self, rs) -> None:
+        if rs.height > self._prune_height:
+            # height advanced: drop encode-cache entries that fell out of
+            # the live gossip window (height-keyed invalidation)
+            self._prune_height = rs.height
+            self._encode_cache.prune_below(rs.height - 1)
+        self._wake_gossip()
         if self.switch is not None:
             self.switch.broadcast(STATE_CHANNEL, encode_msg(self._nrs_message(rs)))
 
     def _broadcast_new_valid_block(self, rs) -> None:
+        self._wake_gossip()
         if self.switch is None:
             return
         psh = (rs.proposal_block_parts.header() if rs.proposal_block_parts
@@ -499,6 +596,7 @@ class ConsensusReactor(Reactor):
             rs.height, rs.round, psh, ba, rs.step == RoundStep.COMMIT)))
 
     def _broadcast_has_vote(self, vote: Vote) -> None:
+        self._wake_gossip("votes")
         if self.switch is not None:
             self.switch.broadcast(STATE_CHANNEL, encode_msg(HasVoteMessage(
                 vote.height, vote.round, vote.type, vote.validator_index)))
@@ -510,6 +608,7 @@ class ConsensusReactor(Reactor):
 
     async def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
         sleep = self.cs.config.peer_gossip_sleep_duration
+        waker = self._wakers.get(peer.id, {}).get("data")
         try:
             while peer.is_running():
                 rs = self.cs.rs
@@ -524,8 +623,9 @@ class ConsensusReactor(Reactor):
                     index, ok = missing.pick_random()
                     if ok:
                         part = rs.proposal_block_parts.get_part(index)
-                        if peer.try_send(DATA_CHANNEL, encode_msg(
-                                BlockPartMessageWire(rs.height, rs.round, part))):
+                        if peer.try_send(DATA_CHANNEL, self._encode_cache.block_part(
+                                rs.height, rs.round,
+                                prs.proposal_block_part_set_header.hash, part)):
                             ps.set_has_proposal_block_part(prs.height, prs.round, index)
                         await asyncio.sleep(0)
                         continue
@@ -536,17 +636,17 @@ class ConsensusReactor(Reactor):
                         and prs.height >= block_store_base):
                     if await self._gossip_catchup_part(peer, ps):
                         continue
-                    await asyncio.sleep(sleep)
+                    await self._gossip_idle(waker, sleep, "data")
                     continue
 
                 if rs.height != prs.height or rs.round != prs.round:
-                    await asyncio.sleep(sleep)
+                    await self._gossip_idle(waker, sleep, "data")
                     continue
 
                 # send the Proposal (+ POL) if the peer lacks it
                 if rs.proposal is not None and not prs.proposal:
-                    if peer.try_send(DATA_CHANNEL, encode_msg(
-                            ProposalMessageWire(rs.proposal))):
+                    if peer.try_send(DATA_CHANNEL,
+                                     self._encode_cache.proposal(rs.proposal)):
                         ps.set_has_proposal(rs.proposal)
                     if 0 <= rs.proposal.pol_round:
                         pol = rs.votes.prevotes(rs.proposal.pol_round)
@@ -556,7 +656,7 @@ class ConsensusReactor(Reactor):
                     await asyncio.sleep(0)
                     continue
 
-                await asyncio.sleep(sleep)
+                await self._gossip_idle(waker, sleep, "data")
         except asyncio.CancelledError:
             pass
 
@@ -578,8 +678,9 @@ class ConsensusReactor(Reactor):
         part = self.cs.block_store.load_block_part(prs.height, index)
         if part is None:
             return False
-        if peer.try_send(DATA_CHANNEL, encode_msg(
-                BlockPartMessageWire(prs.height, prs.round, part))):
+        if peer.try_send(DATA_CHANNEL, self._encode_cache.block_part(
+                prs.height, prs.round,
+                prs.proposal_block_part_set_header.hash, part)):
             prs.proposal_block_parts.set_index(index, True)
             return True
         return False
@@ -588,6 +689,7 @@ class ConsensusReactor(Reactor):
 
     async def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
         sleep = self.cs.config.peer_gossip_sleep_duration
+        waker = self._wakers.get(peer.id, {}).get("votes")
         try:
             while peer.is_running():
                 rs = self.cs.rs
@@ -610,7 +712,7 @@ class ConsensusReactor(Reactor):
                             peer, ps, _VoteSetReader.from_commit(commit)):
                         await asyncio.sleep(0)
                         continue
-                await asyncio.sleep(sleep)
+                await self._gossip_idle(waker, sleep, "votes")
         except asyncio.CancelledError:
             pass
 
@@ -655,7 +757,7 @@ class ConsensusReactor(Reactor):
         vote = ps.pick_vote_to_send(reader)
         if vote is None:
             return False
-        if peer.try_send(VOTE_CHANNEL, encode_msg(VoteMessageWire(vote))):
+        if peer.try_send(VOTE_CHANNEL, self._encode_cache.vote(vote)):
             ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
             return True
         return False

@@ -14,11 +14,13 @@ the reference's per-message mutex hold.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from ..libs.trace import tracer
 from ..state import BlockExecutor, State
 from ..state.store import StateStore
 from ..store import BlockStore
@@ -100,6 +102,10 @@ class ConsensusState:
         self.new_round_step_listeners: List[Callable[[RoundState], None]] = []
         self.valid_block_listeners: List[Callable[[RoundState], None]] = []
         self.vote_listeners: List[Callable[[Vote], None]] = []
+        # fired when new gossip-able proposal data lands (proposal accepted /
+        # block part added) — the reactor wakes per-peer data routines here
+        # instead of them polling on peer_gossip_sleep_duration
+        self.proposal_data_listeners: List[Callable[[], None]] = []
         # maverick hook: votes pushed STRAIGHT to peers, bypassing our own
         # VoteSet (which rightly rejects equivocations)
         self.equivocation_listeners: List[Callable[[Vote], None]] = []
@@ -118,6 +124,7 @@ class ConsensusState:
         self.misbehaviors: dict = {}
 
         self._queue: "asyncio.Queue" = asyncio.Queue(maxsize=1000)
+        self.wal.sync_deadline_s = config.wal_sync_deadline
         self._timeout_task: Optional[asyncio.Task] = None
         self._pending_timeout: Optional[TimeoutInfo] = None
         self._receive_task: Optional[asyncio.Task] = None
@@ -286,28 +293,115 @@ class ConsensusState:
     # -- the single-writer loop (state.go:707) -----------------------------
 
     async def receive_routine(self) -> None:
+        grouped = self.config.wal_group_commit
+        max_batch = (max(1, self.config.wal_group_commit_max_batch)
+                     if grouped else 1)
         while not self._stopped:
             # queue.get() on a non-empty queue does not suspend; without this
             # yield a busy chain (internal msgs re-enqueue forever) starves
             # every other task and timer on the loop.
             await asyncio.sleep(0)
             item = await self._queue.get()
+            batch = [item]
+            while len(batch) < max_batch and not self._queue.empty():
+                batch.append(self._queue.get_nowait())
+            # phase 1 — WAL every record in the batch under ONE group commit
+            # (a single fsync covers all own messages), BEFORE any of them
+            # acts on the round state: an own message is always durable
+            # before the transition that exposes it to gossip, exactly the
+            # reference's per-record write-sync-then-handle guarantee with
+            # the syncs coalesced. A record that fails to write drops its
+            # message from phase 2 (as a failed write always skipped the
+            # handle), without dropping the rest of the batch.
+            loggable = []
             try:
-                if isinstance(item, TimeoutInfo):
-                    self.wal.write_timeout(item, now_ns())
-                    self._handle_timeout(item)
-                elif isinstance(item, _MsgInfo):
-                    self.wal.write_msg_info(item.msg, item.peer_id, now_ns(),
-                                            internal=item.peer_id == "")
-                    self._handle_msg(item)
-                elif item == "txs_available":
-                    self._handle_txs_available()
+                # with group commit disabled this is the exact legacy path:
+                # batch size 1, no group() — own records fsync per record,
+                # peer records are flushed but never fsynced
+                ctx = (self.wal.group() if grouped
+                       else contextlib.nullcontext())
+                with tracer.span("wal_group", n=len(batch),
+                                 height=self.rs.height), ctx:
+                    for it in batch:
+                        try:
+                            if isinstance(it, TimeoutInfo):
+                                self.wal.write_timeout(it, now_ns())
+                            elif isinstance(it, _MsgInfo):
+                                self.wal.write_msg_info(
+                                    it.msg, it.peer_id, now_ns(),
+                                    internal=it.peer_id == "")
+                            loggable.append(it)
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:
+                            logger.exception(
+                                "error writing consensus WAL record "
+                                "(height=%d round=%d step=%s)",
+                                self.rs.height, self.rs.round, self.rs.step)
             except asyncio.CancelledError:
                 raise
             except Exception:
-                logger.exception("error in consensus receive routine "
-                                 "(height=%d round=%d step=%s)",
-                                 self.rs.height, self.rs.round, self.rs.step)
+                # the group's deferred flush/fsync failed (disk full, EIO):
+                # the batch's records may not be durable. Match the
+                # per-record behavior — a failed sync skipped that message —
+                # by dropping OWN messages from handling (their durability
+                # rule would be violated) while peer messages, which were
+                # never synced in the reference either, still proceed. The
+                # loop itself must survive: it is an unsupervised task.
+                logger.exception(
+                    "consensus WAL group commit failed "
+                    "(height=%d round=%d step=%s); dropping own messages "
+                    "from this batch", self.rs.height, self.rs.round,
+                    self.rs.step)
+                loggable = [it for it in loggable
+                            if not (isinstance(it, _MsgInfo)
+                                    and it.peer_id == "")]
+            # phase 2 — handle in arrival order. A commit inside the batch
+            # writes its #ENDHEIGHT marker AFTER records phase 1 already
+            # appended, and crash replay reads only messages after the LAST
+            # marker — so any not-yet-handled records of this batch would be
+            # invisible to recovery. Re-log the remainder after the marker:
+            # replay skips the pre-marker copies and sees exactly the record
+            # sequence per-record sync would have produced. (Own messages
+            # for the new height cannot be in the remainder — the state
+            # machine only enqueues them after the commit ran, i.e. into a
+            # later batch — so the re-log needs no fsync of its own.)
+            for i, it in enumerate(loggable):
+                committed_h = self.state.last_block_height
+                try:
+                    if isinstance(it, TimeoutInfo):
+                        self._handle_timeout(it)
+                    elif isinstance(it, _MsgInfo):
+                        self._handle_msg(it)
+                    elif it == "txs_available":
+                        self._handle_txs_available()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("error in consensus receive routine "
+                                     "(height=%d round=%d step=%s)",
+                                     self.rs.height, self.rs.round, self.rs.step)
+                rest = loggable[i + 1:]
+                if self.state.last_block_height == committed_h or not rest:
+                    continue
+                try:
+                    with self.wal.group():
+                        for rem in rest:
+                            if isinstance(rem, TimeoutInfo):
+                                self.wal.write_timeout(rem, now_ns())
+                            elif isinstance(rem, _MsgInfo):
+                                self.wal.write_msg_info(
+                                    rem.msg, rem.peer_id, now_ns(),
+                                    internal=rem.peer_id == "")
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # the pre-marker copies are still on disk (just not
+                    # replayed after a crash) and any own record was already
+                    # fsynced in phase 1 — keep handling
+                    logger.exception(
+                        "error re-logging batch remainder after commit "
+                        "(height=%d)", self.state.last_block_height)
 
     def _handle_msg(self, mi: _MsgInfo) -> None:
         """(state.go:799 handleMsg)"""
@@ -840,6 +934,8 @@ class ConsensusState:
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
         logger.info("received proposal %d/%d", proposal.height, proposal.round)
+        for listener in self.proposal_data_listeners:
+            listener()
 
     def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
         """(state.go:1850)"""
@@ -849,6 +945,9 @@ class ConsensusState:
         if rs.proposal_block_parts is None:
             return False
         added = rs.proposal_block_parts.add_part(msg.part)
+        if added:
+            for listener in self.proposal_data_listeners:
+                listener()
         if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
             raise ValueError(
                 f"total size of proposal block parts exceeds maximum block bytes "

@@ -300,6 +300,31 @@ class ConsensusMetrics:
         self.latest_block_height = g(
             "consensus", "latest_block_height",
             "Alias of committed height for dashboards.")
+        # -- live consensus plane (event-driven gossip + WAL group commit) --
+        self.gossip_wakeups_total = c(
+            "consensus", "gossip_wakeups_total",
+            "Gossip iterations triggered by an event wakeup.", ["routine"])
+        self.gossip_polls_total = c(
+            "consensus", "gossip_polls_total",
+            "Gossip iterations triggered by the fallback sleep cap.",
+            ["routine"])
+        self.encode_cache_hits_total = c(
+            "consensus", "encode_cache_hits_total",
+            "Wire-encode cache hits (one encode served many sends).",
+            ["kind"])
+        self.encode_cache_misses_total = c(
+            "consensus", "encode_cache_misses_total",
+            "Wire-encode cache misses (message encoded fresh).", ["kind"])
+        self.wal_fsyncs_total = c(
+            "consensus", "wal_fsyncs_total", "WAL fsync calls.")
+        self.wal_records_per_fsync = h(
+            "consensus", "wal_records_per_fsync",
+            "WAL records made durable by each fsync (group-commit batch).",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self.wal_fsync_seconds = h(
+            "consensus", "wal_fsync_seconds", "WAL fsync latency.",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1))
 
 
 class MempoolMetrics:

@@ -197,6 +197,10 @@ class Node:
 
         self.metrics = NodeMetrics(config.instrumentation.namespace)
         self.consensus_state.metrics = self.metrics.consensus
+        # live-plane series: WAL group-commit fsync stats + the reactor's
+        # gossip wakeup/poll and wire-encode-cache counters
+        self.consensus_state.wal.metrics = self.metrics.consensus
+        self.consensus_reactor.set_metrics(self.metrics.consensus)
         self.mempool.metrics = self.metrics.mempool
         self.block_exec.metrics = self.metrics.state
         from .p2p.conn.mconnection import set_p2p_metrics

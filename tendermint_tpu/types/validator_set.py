@@ -47,6 +47,11 @@ class ValidatorSet:
         self.validators: List[Validator] = []
         self.proposer: Optional[Validator] = None
         self._total_voting_power: Optional[int] = None
+        # structural-mutation counter: every mutator that changes membership
+        # or ORDER bumps it, so the _addr_index/hash memos below cannot go
+        # stale even for an in-place mutation that preserves the list
+        # object's identity and length (advisor finding at _addr_index)
+        self._mutations = 0
         if validators is not None:
             self._update_with_change_set([v.copy() for v in validators], allow_deletes=False)
             if len(self.validators) > 0:
@@ -64,6 +69,7 @@ class ValidatorSet:
         vs = cls()
         vs.validators = sorted((v.copy() for v in validators),
                                key=_by_voting_power)
+        vs._bump_mutations()
         if vs.validators:
             # findPreviousProposer (validator_set.go:832): the chosen
             # proposer was decremented by the total power, so it is the one
@@ -95,30 +101,40 @@ class ValidatorSet:
         vs._total_voting_power = self._total_voting_power
         # membership and powers are identical, so the merkle hash carries
         # over (priorities are not part of bytes_for_hash); re-keyed to the
-        # copy's own list so later structural mutations invalidate normally
+        # copy's own list + mutation count so later structural mutations
+        # invalidate normally
         cache = self.__dict__.get("_hash_cache")
         if cache is not None and cache[0] is self.validators \
-                and cache[1] == len(self.validators):
-            vs.__dict__["_hash_cache"] = (vs.validators, len(vs.validators),
-                                          cache[2])
+                and cache[1] == self._mutations \
+                and cache[2] == len(self.validators):
+            vs.__dict__["_hash_cache"] = (vs.validators, vs._mutations,
+                                          len(vs.validators), cache[3])
         return vs
+
+    def _bump_mutations(self) -> None:
+        """Every structural mutator (membership OR order change) must call
+        this; the _addr_index/hash memos key on the counter, so an in-place
+        mutation that preserves list identity and length still invalidates."""
+        self._mutations += 1
 
     def _addr_index(self) -> dict:
         """address -> index, rebuilt whenever the validators list object is
-        replaced or resized (every structural mutation reassigns the list;
-        priority updates mutate Validator objects but never addresses or
+        replaced, resized, or a structural mutator bumps ``_mutations``
+        (priority updates mutate Validator objects but never addresses or
         order, so the cache stays valid across IncrementProposerPriority).
         At light-client/commit-verification scale the linear scan was the
         single hottest host-side cost (1000-validator sets x 32k lookups)."""
         cache = self.__dict__.get("_addr_cache")
         if (cache is None or cache[0] is not self.validators
-                or len(cache[1]) != len(self.validators)):
+                or cache[1] != self._mutations
+                or cache[2] != len(self.validators)):
             idx: dict = {}
             for i, v in enumerate(self.validators):
                 idx.setdefault(v.address, i)  # first match wins, like the scan
-            cache = (self.validators, idx)
+            cache = (self.validators, self._mutations, len(self.validators),
+                     idx)
             self.__dict__["_addr_cache"] = cache
-        return cache[1]
+        return cache[3]
 
     def has_address(self, address: bytes) -> bool:
         return address in self._addr_index()
@@ -153,22 +169,24 @@ class ValidatorSet:
     def hash(self) -> bytes:
         """Merkle root of SimpleValidator encodings (validator_set.go:347).
 
-        Memoized under the same invalidation contract as _addr_index: every
-        structural mutation reassigns (or resizes) the validators list, and
-        priority rotation — the only in-place mutation — does not touch
-        bytes_for_hash. validate_block hashes two 1000-validator sets per
-        block, and copy() propagates the memo, so steady-state fast sync
-        pays the merkle pass only when membership actually changes."""
+        Memoized under the same invalidation contract as _addr_index (list
+        identity + length + the structural mutation counter): priority
+        rotation — the only in-place mutation that doesn't bump the counter
+        — does not touch bytes_for_hash. validate_block hashes two
+        1000-validator sets per block, and copy() propagates the memo, so
+        steady-state fast sync pays the merkle pass only when membership
+        actually changes."""
         cache = self.__dict__.get("_hash_cache")
         if (cache is None or cache[0] is not self.validators
-                or cache[1] != len(self.validators)):
+                or cache[1] != self._mutations
+                or cache[2] != len(self.validators)):
             from ..crypto import merkle
 
             h = merkle.hash_from_byte_slices(
                 [v.bytes_for_hash() for v in self.validators])
-            cache = (self.validators, len(self.validators), h)
+            cache = (self.validators, self._mutations, len(self.validators), h)
             self.__dict__["_hash_cache"] = cache
-        return cache[2]
+        return cache[3]
 
     def validate_basic(self) -> None:
         if self.is_nil_or_empty():
@@ -274,7 +292,10 @@ class ValidatorSet:
         self._update_total_voting_power()
         self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
         self._shift_by_avg_proposer_priority()
-        self.validators.sort(key=_by_voting_power)
+        # reassign (not in-place sort) AND bump: either alone invalidates
+        # the _addr_index/hash memos; both keeps the invariant obvious
+        self.validators = sorted(self.validators, key=_by_voting_power)
+        self._bump_mutations()
 
     def _verify_removals(self, deletes: List[Validator]) -> int:
         removed = 0
@@ -462,6 +483,7 @@ class ValidatorSet:
             elif fn == 2:
                 vs.proposer = Validator.decode(v)
         vs._total_voting_power = None
+        vs._bump_mutations()
         return vs
 
 

@@ -92,6 +92,10 @@ def test_xchacha20poly1305_hchacha_vector_and_aead():
     with associated data."""
     import os
 
+    import pytest
+
+    pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
     from tendermint_tpu.crypto import xchacha20poly1305 as X
 
     key = bytes.fromhex("000102030405060708090a0b0c0d0e0f"

@@ -4,6 +4,10 @@ evidence resolves the validator through the current set, LightClientAttack
 carries its list, and a validator appearing in several items counts once."""
 
 import numpy as np
+import pytest
+
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
 from tendermint_tpu import crypto

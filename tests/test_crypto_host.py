@@ -147,6 +147,9 @@ def test_merkle_proofs(n):
 def test_secp256k1_sign_verify_address():
     """secp256k1 key type (reference crypto/secp256k1): 33B compressed pub,
     RIPEMD160(SHA256(pub)) address, 64B low-S signatures."""
+    import pytest
+
+    pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from tendermint_tpu.crypto.secp256k1 import (
         Secp256k1PrivKey,
         Secp256k1PubKey,

@@ -9,6 +9,8 @@ import random
 
 import pytest
 
+pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
+
 from tendermint_tpu.e2e import Manifest, Runner
 from tendermint_tpu.e2e.generate import doc_to_toml, generate, generate_one
 

@@ -12,6 +12,8 @@ import os
 
 import pytest
 
+pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
+
 from tendermint_tpu.e2e import Manifest, Runner
 
 MANIFESTS = os.path.join(

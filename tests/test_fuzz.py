@@ -3,6 +3,9 @@ mempool CheckTx, secret-connection read/write, pubsub query parser, wire
 codecs) via hypothesis.
 """
 
+import pytest
+
+pytest.importorskip("hypothesis", reason="property fuzzing needs the optional 'hypothesis' package")
 import hypothesis.strategies as st
 import pytest
 from hypothesis import HealthCheck, given, settings
@@ -117,6 +120,7 @@ def test_secret_connection_rejects_garbage_frames():
     ciphertext must produce a clean failure, not a hang or crash."""
     import asyncio
 
+    pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from tests.test_p2p_tcp import _spawn_pair
 
     async def run():

@@ -8,6 +8,8 @@ encoder — it is the host-side cost floor of the batched device path.
 
 import numpy as np
 import pytest
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
 from tendermint_tpu import crypto

@@ -132,3 +132,28 @@ def test_gauge_still_supports_inc_and_set():
 def test_histogram_render_empty_is_header_only():
     h = Histogram("h", "help.")
     assert h.render() == ["# HELP h help.", "# TYPE h histogram"]
+
+
+def test_node_metrics_includes_live_plane_series():
+    """The event-driven live-plane series (gossip wakeups/polls, encode
+    cache, WAL group commit) render on the shared registry — i.e. they are
+    visible on the node's /metrics endpoint."""
+    nm = NodeMetrics("tendermint")
+    c = nm.consensus
+    c.gossip_wakeups_total.labels("votes").inc()
+    c.gossip_polls_total.labels("data").inc(3)
+    c.encode_cache_hits_total.labels("vote").inc(5)
+    c.encode_cache_misses_total.labels("block_part").inc()
+    c.wal_fsyncs_total.inc(2)
+    c.wal_records_per_fsync.observe(8)
+    c.wal_fsync_seconds.observe(0.002)
+    text = nm.registry.render()
+    assert 'tendermint_consensus_gossip_wakeups_total{routine="votes"} 1' in text
+    assert 'tendermint_consensus_gossip_polls_total{routine="data"} 3' in text
+    assert 'tendermint_consensus_encode_cache_hits_total{kind="vote"} 5' in text
+    assert ('tendermint_consensus_encode_cache_misses_total'
+            '{kind="block_part"} 1') in text
+    assert "tendermint_consensus_wal_fsyncs_total 2" in text
+    assert 'tendermint_consensus_wal_records_per_fsync_bucket{le="8"} 1' in text
+    assert "tendermint_consensus_wal_records_per_fsync_sum 8" in text
+    assert "# TYPE tendermint_consensus_wal_fsync_seconds histogram" in text

@@ -8,6 +8,8 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from tendermint_tpu import crypto
 from tendermint_tpu.libs.merlin import Transcript
 from tendermint_tpu.p2p import (

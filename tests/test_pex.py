@@ -7,6 +7,8 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from tendermint_tpu import crypto
 from tendermint_tpu.p2p import NetAddress, NodeInfo, NodeKey, Switch, TCPTransport
 from tendermint_tpu.p2p.pex import (

@@ -8,6 +8,8 @@ import threading
 
 import pytest
 
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV
 from tendermint_tpu.privval.signer import (
     RemoteSignerError,

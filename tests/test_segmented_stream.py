@@ -111,6 +111,9 @@ def test_dispatch_stream_dense_fallback_shapes():
     (K, NBLK, 32, B, LANE) layout contract: verdicts land in row order.
     Small shapes only — the heavy differential coverage is in
     test_sparse_verify (CPU) and test_tpu_device (real chip, segmented)."""
+    import pytest
+
+    pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     rng = np.random.default_rng(2)
     pks, msgs, sigs = [], [], []
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (

@@ -10,6 +10,8 @@ shipping a template + differing columns cuts host->device transfer ~2.5x.
 
 import numpy as np
 import pytest
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
 from tendermint_tpu.crypto import ed25519 as host

@@ -9,6 +9,9 @@ import os
 
 import pytest
 
+pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from tendermint_tpu import crypto
 from tendermint_tpu.abci.example.kvstore import SnapshotKVStoreApplication
 from tendermint_tpu.config import test_config

@@ -166,6 +166,9 @@ def test_kvstore_validator_update_tx(chain):
 
 def _mk_pointer_valset(n=5, seed=3, base_power=10):
     import numpy as np
+    import pytest
+
+    pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )

@@ -248,3 +248,68 @@ def test_from_existing_preserves_proposer_rotation():
     fresh = ValidatorSet([v.copy() for v in vs.validators])
     assert [v.proposer_priority for v in fresh.validators] != \
         [v.proposer_priority for v in vs.validators]
+
+
+class TestAddrIndexInvalidation:
+    """_addr_index/hash memo staleness (advisor finding at
+    validator_set.py:105): the caches must invalidate on the structural
+    mutation COUNTER, not just list identity/length, because an in-place
+    mutation that preserves both would otherwise serve stale indices into
+    commit verification."""
+
+    def test_identity_and_length_preserving_mutation_invalidates(self):
+        vals, _ = make_vals(4)
+        vs = ValidatorSet(vals)
+        # warm both memos
+        for v in vs.validators:
+            assert vs.get_by_address(v.address)[0] >= 0
+        h0 = vs.hash()
+        # an in-place reorder that preserves list identity AND length —
+        # the exact mutation class the identity/length check misses. Any
+        # future structural mutator must pair its mutation with
+        # _bump_mutations(); this asserts the memos honor the counter.
+        vs.validators.reverse()
+        vs._bump_mutations()
+        for i, v in enumerate(vs.validators):
+            idx, got = vs.get_by_address(v.address)
+            assert idx == i, "stale _addr_index after in-place reorder"
+            assert got.address == v.address
+        assert vs.hash() != h0 or len(vs.validators) == 1
+
+    def test_update_with_change_set_reorders_index_correctly(self):
+        # a power update that FLIPS sort order must leave fresh indices
+        vals, _ = make_vals(3, power=10)
+        vs = ValidatorSet(vals)
+        for v in vs.validators:
+            vs.get_by_address(v.address)  # warm
+        last = vs.validators[-1]
+        vs.update_with_change_set([new_validator(last.pub_key, 99)])
+        assert vs.validators[0].address == last.address  # power desc
+        for i, v in enumerate(vs.validators):
+            assert vs.get_by_address(v.address)[0] == i
+
+    def test_priority_rotation_keeps_cache(self):
+        # proposer-priority rotation mutates Validator objects only — the
+        # memoized index dict must be REUSED (the perf property the memo
+        # exists for), and stay correct
+        vals, _ = make_vals(5)
+        vs = ValidatorSet(vals)
+        idx0 = vs._addr_index()
+        vs.increment_proposer_priority(3)
+        assert vs._addr_index() is idx0
+        for i, v in enumerate(vs.validators):
+            assert vs.get_by_address(v.address)[0] == i
+
+    def test_copy_propagates_hash_and_stays_fresh(self):
+        vals, _ = make_vals(3)
+        vs = ValidatorSet(vals)
+        h0 = vs.hash()
+        c = vs.copy()
+        assert c.hash() == h0
+        # mutating the copy must not poison the original (and vice versa)
+        newp = crypto.Ed25519PrivKey.generate(b"\x44" * 32)
+        c.update_with_change_set([new_validator(newp.pub_key(), 7)])
+        assert c.hash() != h0
+        assert vs.hash() == h0
+        assert c.get_by_address(newp.pub_key().address())[0] >= 0
+        assert vs.get_by_address(newp.pub_key().address())[0] == -1

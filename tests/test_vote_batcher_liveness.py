@@ -9,6 +9,10 @@ import threading
 import time
 
 import numpy as np
+import pytest
+
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
 from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
 from tendermint_tpu import crypto

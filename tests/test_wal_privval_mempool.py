@@ -220,3 +220,192 @@ class TestMempool:
         entries, cursor = mp.entries_after(0)
         assert entries[0].senders == {"peer1", "peer2"}
         assert cursor == 1
+
+
+# --- WAL group commit ------------------------------------------------------
+
+class TestWALGroupCommit:
+    RECORDS = [
+        # a proposal-plus-parts-shaped batch: internal (sync-wanted) records
+        # mixed with external peer records, as the receive loop drains them
+        ("proposal", {"proposal": "aa", "peer": ""}, 10, True),
+        ("block_part", {"height": 1, "round": 0, "part": "bb", "peer": ""}, 11, True),
+        ("block_part", {"height": 1, "round": 0, "part": "cc", "peer": ""}, 12, True),
+        ("vote", {"vote": "dd", "peer": "p1"}, 13, False),
+        ("timeout", {"duration_s": 0.5, "height": 1, "round": 0, "step": 3}, 14, False),
+        ("vote", {"vote": "ee", "peer": ""}, 15, True),
+    ]
+
+    def _write(self, wal):
+        for type_, data, ts, sync in self.RECORDS:
+            (wal.write_sync if sync else wal.write)(type_, data, ts)
+
+    def test_group_commit_replay_byte_identical(self, tmp_path):
+        """A group-committed WAL is BYTE-identical to the per-record-sync
+        WAL for the same records — replay (and therefore recovered state)
+        cannot differ; only the fsync schedule does."""
+        per = WAL(str(tmp_path / "per.wal"))
+        self._write(per)
+        per.close()
+        grp = WAL(str(tmp_path / "grp.wal"))
+        with grp.group():
+            self._write(grp)
+        grp.close()
+        per_bytes = open(str(tmp_path / "per.wal"), "rb").read()
+        grp_bytes = open(str(tmp_path / "grp.wal"), "rb").read()
+        assert per_bytes == grp_bytes and len(per_bytes) > 0
+        per_msgs = [(m.type, m.data, m.time_ns)
+                    for m in WAL(str(tmp_path / "per.wal")).iter_messages()]
+        grp_msgs = [(m.type, m.data, m.time_ns)
+                    for m in WAL(str(tmp_path / "grp.wal")).iter_messages()]
+        assert per_msgs == grp_msgs
+        assert len(per_msgs) == len(self.RECORDS) + 1  # + auto #ENDHEIGHT 0
+
+    def test_group_commit_single_fsync(self, tmp_path, monkeypatch):
+        from tendermint_tpu.libs.metrics import NodeMetrics
+
+        import tendermint_tpu.consensus.wal as walmod
+
+        wal = WAL(str(tmp_path / "w.wal"))  # init fsync happens unpatched
+        wal.metrics = NodeMetrics("t_gc1").consensus
+        calls = []
+        monkeypatch.setattr(walmod.os, "fsync", lambda fd: calls.append(fd))
+        with wal.group():
+            self._write(wal)  # 3 sync-wanted records in the batch
+        assert len(calls) == 1, "group commit must coalesce to ONE fsync"
+        m = wal.metrics
+        assert m.wal_fsyncs_total.value() == 1
+        assert m.wal_records_per_fsync.count_value() == 1
+        assert m.wal_records_per_fsync.sum_value() == len(self.RECORDS)
+        # per-record comparison: same records, one fsync per sync-wanted one
+        calls.clear()
+        wal2 = WAL(str(tmp_path / "w2.wal"))
+        self._write(wal2)
+        n_sync = sum(1 for r in self.RECORDS if r[3])
+        assert len(calls) == n_sync + 1  # + the fresh-WAL #ENDHEIGHT 0
+
+    def test_group_commit_external_only_respects_deadline(self, tmp_path,
+                                                          monkeypatch):
+        import tendermint_tpu.consensus.wal as walmod
+
+        wal = WAL(str(tmp_path / "w.wal"))
+        calls = []
+        monkeypatch.setattr(walmod.os, "fsync", lambda fd: calls.append(fd))
+        wal.sync_deadline_s = 3600.0  # never due within the test
+        with wal.group():
+            wal.write("vote", {"vote": "aa", "peer": "p1"}, 1)
+            wal.write("vote", {"vote": "bb", "peer": "p2"}, 2)
+        assert calls == [], "peer-only batch must not fsync before deadline"
+        wal.sync_deadline_s = 0.0  # always due
+        with wal.group():
+            wal.write("vote", {"vote": "cc", "peer": "p1"}, 3)
+        assert len(calls) == 1, "deadline must bound the async tail's lag"
+
+    def test_batch_crossing_commit_relogs_remainder(self, tmp_path):
+        """A commit inside a drained batch writes #ENDHEIGHT AFTER records
+        phase 1 already appended; crash replay reads only messages after the
+        LAST marker, so the batch's unhandled remainder must be re-logged
+        after it — otherwise messages that mutated the live round state
+        before a crash would silently vanish from recovery."""
+        import asyncio
+
+        from tests.test_consensus_single import build_node
+
+        from tendermint_tpu.consensus.state import VoteMessage, _MsgInfo
+
+        def _vote(h, idx_sig):
+            return Vote(SignedMsgType.PREVOTE, h, 0, BID,
+                        1_700_000_000_000_000_000, b"\xaa" * 20, 0,
+                        bytes([idx_sig]) * 64)
+
+        async def run():
+            wal = WAL(str(tmp_path / "t.wal"))
+            cs, *_ = build_node(wal=wal)
+            assert cs.config.wal_group_commit
+            commit_trigger = _MsgInfo(VoteMessage(_vote(1, 1)), "p1")
+            straggler = _MsgInfo(VoteMessage(_vote(2, 2)), "p2")
+
+            def fake_handle(mi):
+                if mi is commit_trigger:
+                    # what finalize-commit does mid-batch: marker + height
+                    cs.wal.write_end_height(1, 999)
+                    cs.state.last_block_height = 1
+
+            cs._handle_msg = fake_handle
+            cs._queue.put_nowait(commit_trigger)
+            cs._queue.put_nowait(straggler)
+            task = asyncio.get_event_loop().create_task(cs.receive_routine())
+            while not cs._queue.empty():
+                await asyncio.sleep(0.01)
+            await asyncio.sleep(0.05)
+            task.cancel()
+            wal.close()
+
+        asyncio.run(run())
+        replayed = WAL(str(tmp_path / "t.wal")).messages_after_end_height(1)
+        votes = [m for m in replayed if m.type == "vote"]
+        assert len(votes) == 1, ("straggler record lost across the "
+                                 f"#ENDHEIGHT marker: {replayed}")
+        assert votes[0].data["vote"] == _vote(2, 2).encode().hex()
+        # and the pre-marker copy is still there (phase 1 wrote it first)
+        all_votes = [m for m in WAL(str(tmp_path / "t.wal")).iter_messages()
+                     if m.type == "vote"]
+        assert len(all_votes) == 3  # trigger + straggler + re-logged copy
+
+    def test_own_messages_durable_before_handled(self, tmp_path):
+        """The reference durability rule (state.go:754,763) under group
+        commit: every internal record is fsynced before its message acts on
+        the state machine — and therefore before any transition can expose
+        it to gossip sends."""
+        import asyncio
+
+        from tests.test_consensus_single import build_node, wait_for_height
+
+        events = []
+
+        class TracingWAL(WAL):
+            def write_msg_info(self, msg, peer_id, time_ns, internal):
+                events.append(("record", internal))
+                super().write_msg_info(msg, peer_id, time_ns, internal)
+
+            def _fsync(self):
+                events.append(("fsync",))
+                super()._fsync()
+
+        async def run():
+            wal = TracingWAL(str(tmp_path / "t.wal"))
+            cs, mempool, app, bus, pv, _ = build_node(wal=wal)
+            assert cs.config.wal_group_commit
+            orig_handle = cs._handle_msg
+
+            def traced(mi):
+                events.append(("handle", mi.peer_id == ""))
+                orig_handle(mi)
+
+            cs._handle_msg = traced
+            await cs.start()
+            try:
+                await wait_for_height(bus, cs, 2)
+            finally:
+                await cs.stop()
+
+        asyncio.run(run())
+        pending_internal = 0
+        batch_sizes = []
+        since_sync = 0
+        for ev in events:
+            if ev[0] == "record":
+                since_sync += 1
+                if ev[1]:
+                    pending_internal += 1
+            elif ev[0] == "fsync":
+                pending_internal = 0
+                if since_sync:
+                    batch_sizes.append(since_sync)
+                since_sync = 0
+            elif ev == ("handle", True):
+                assert pending_internal == 0, \
+                    "own message handled before its WAL record was fsynced"
+        # the proposal + its block part(s) are enqueued together, so at
+        # least one fsync must have covered a multi-record batch
+        assert batch_sizes and max(batch_sizes) >= 2, batch_sizes

@@ -61,6 +61,37 @@ def summarize(events: List[dict]) -> Dict[str, dict]:
     return out
 
 
+def by_height(events: List[dict]) -> Dict[int, Dict[str, float]]:
+    """height -> {span name -> total_us} for events whose args carry a
+    height (``height`` or ``h``). This is the live-plane attribution view:
+    where each committed height's wall-clock went — gossip wait
+    (``gossip_idle``), WAL sync (``wal_group``/``wal_fsync``), verify
+    (``batch_verify``/``verify_window``), apply (``apply_block``)."""
+    out: Dict[int, Dict[str, float]] = {}
+    for e in events:
+        args = e.get("args") or {}
+        h = args.get("height", args.get("h"))
+        if not isinstance(h, int):
+            continue
+        per = out.setdefault(h, {})
+        per[e["name"]] = per.get(e["name"], 0.0) + float(e.get("dur", 0.0))
+    return {h: {n: round(v, 1) for n, v in sorted(per.items())}
+            for h, per in sorted(out.items())}
+
+
+def render_by_height(table: Dict[int, Dict[str, float]]) -> str:
+    if not table:
+        return "(no height-tagged events)"
+    names = sorted({n for per in table.values() for n in per})
+    head = "height  " + "  ".join(f"{n:>{max(len(n), 10)}}" for n in names)
+    lines = [head]
+    for h, per in table.items():
+        cells = "  ".join(f"{per.get(n, 0.0) / 1000.0:>{max(len(n), 10)}.2f}"
+                          for n in names)
+        lines.append(f"{h:>6}  {cells}")
+    return "\n".join(lines) + "\n(cells: total ms per height)"
+
+
 def render(summary: Dict[str, dict]) -> str:
     if not summary:
         return "(no events)"
@@ -91,21 +122,35 @@ def self_test() -> int:
             t += dur
     events.append({"name": "vote_flush", "ph": "i", "s": "t", "ts": t,
                    "pid": 1, "tid": 1})
+    # height-tagged live-plane spans (consensus state.py / reactor.py emit
+    # exactly this shape) for the --by-height view
+    for h in (5, 5, 6):
+        for name, dur in (("gossip_idle", 40.0), ("wal_group", 3.0),
+                          ("apply_block", 55.0)):
+            events.append({"name": name, "ph": "X", "ts": t, "dur": dur,
+                           "pid": 1, "tid": 1, "args": {"height": h}})
+            t += dur
     fd, path = tempfile.mkstemp(suffix=".json")
     try:
         with os.fdopen(fd, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-        summary = summarize(load_events(path))
+        loaded = load_events(path)
+        summary = summarize(loaded)
+        heights = by_height(loaded)
     finally:
         os.unlink(path)
-    assert len(summary) == 5, summary
+    assert len(summary) == 7, summary
     assert summary["apply_window"]["count"] == 8
     assert summary["apply_window"]["p50_us"] == 900.0
     assert summary["vote_flush"]["total_us"] == 0.0
     assert summary["verify_window"]["p99_us"] >= summary["verify_window"]["p50_us"]
+    assert set(heights) == {5, 6}, heights
+    assert heights[5]["gossip_idle"] == 80.0
+    assert heights[6]["wal_group"] == 3.0
+    assert "gossip_idle" in render_by_height(heights)
     print("trace_summary self-test OK "
           f"({len(summary)} spans, {sum(s['count'] for s in summary.values())}"
-          " events)")
+          f" events, {len(heights)} heights)")
     return 0
 
 
@@ -114,6 +159,10 @@ def main(argv=None) -> int:
     ap.add_argument("trace", nargs="?", help="Chrome trace-event JSON path")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as JSON instead of a table")
+    ap.add_argument("--by-height", action="store_true",
+                    help="group height-tagged spans (gossip_idle, wal_group, "
+                         "apply_block, verify/apply windows) per height — "
+                         "the live-plane latency attribution view")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in round-trip check and exit")
     args = ap.parse_args(argv)
@@ -121,7 +170,16 @@ def main(argv=None) -> int:
         return self_test()
     if not args.trace:
         ap.error("trace path required (or --self-test)")
-    summary = summarize(load_events(args.trace))
+    events = load_events(args.trace)
+    if args.by_height:
+        table = by_height(events)
+        if args.json:
+            print(json.dumps({str(h): per for h, per in table.items()},
+                             indent=2))
+        else:
+            print(render_by_height(table))
+        return 0
+    summary = summarize(events)
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
