@@ -8,4 +8,4 @@ PrecommitWait → Commit, with WAL-before-act crash recovery.
 from .config import ConsensusConfig  # noqa: F401
 from .round_state import HeightVoteSet, RoundState, RoundStep  # noqa: F401
 from .state import ConsensusState  # noqa: F401
-from .wal import WAL, NilWAL  # noqa: F401
+from .wal import WAL, FsyncError, NilWAL  # noqa: F401

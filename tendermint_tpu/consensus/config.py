@@ -34,6 +34,19 @@ class ConsensusConfig:
     # fsync deadline for grouped batches with only peer records (which the
     # reference never syncs at all; this bounds the async tail's lag)
     wal_sync_deadline: float = 0.05
+    # self-healing gossip: a peer silent for this long AND behind our
+    # height gets its delivery bitmaps cleared so catchup re-sends
+    # (PeerState.refresh_if_stalled; the behind-gate is in the reactor).
+    # Gossip marks votes/parts delivered ON SEND — sound over reliable
+    # TCP, but a lossy/blackholed link silently eats sends and the
+    # bookkeeping then wedges the link forever. Quiet for healthy nets:
+    # a peer at our height triggers nothing. 0 disables.
+    gossip_stall_refresh_s: float = 10.0
+    # stall watchdog: no committed-height advance for this many seconds →
+    # consensus_stalled_total + a debugdump bundle (consensus/watchdog.py).
+    # 0 disables (default: a net configured to idle between txs would
+    # false-positive); e2e/chaos nets enable it.
+    stall_watchdog_s: float = 0.0
 
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

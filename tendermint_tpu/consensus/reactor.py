@@ -100,6 +100,40 @@ class PeerState:
     def __init__(self, peer: Peer):
         self.peer = peer
         self.prs = PeerRoundState()
+        self.last_recv_t = time.monotonic()
+
+    def note_recv(self) -> None:
+        self.last_recv_t = time.monotonic()
+
+    def refresh_if_stalled(self, stall_s: float) -> bool:
+        """Self-healing gossip: downgrade a silent peer's delivery bitmaps
+        from facts to guesses. Gossip marks a vote/part as delivered when
+        it SENDS it (reactor.go PickSendVote semantics) — sound over the
+        reliable TCP transport, but a lossy or blackholed link (partition,
+        dying relay, chaos LinkPolicy) eats sends silently and the bitmaps
+        then claim the peer has data it never saw: catchup stops and the
+        link wedges permanently. After ``stall_s`` without a single
+        message from the peer, clear what we think we delivered so the
+        gossip routines re-send — duplicates are cheap (PartSet/VoteSet
+        dedup), a poisoned bitmap is a liveness hole. Height/round/step
+        are kept: those came FROM the peer."""
+        if stall_s <= 0:
+            return False
+        now = time.monotonic()
+        if now - self.last_recv_t < stall_s:
+            return False
+        self.last_recv_t = now  # one refresh per silent interval
+        prs = self.prs
+        prs.proposal = False
+        if prs.proposal_block_parts is not None:
+            prs.proposal_block_parts = BitArray(
+                prs.proposal_block_parts.size())
+        for name in ("prevotes", "precommits", "last_commit",
+                     "catchup_commit", "proposal_pol"):
+            ba = getattr(prs, name)
+            if ba is not None:
+                setattr(prs, name, BitArray(ba.size()))
+        return True
 
     # -- updates from messages --------------------------------------------
 
@@ -402,6 +436,24 @@ class ConsensusReactor(Reactor):
         for w in self._wakers.get(peer_id, {}).values():
             w.wake()
 
+    def _maybe_refresh_peer(self, ps: PeerState) -> None:
+        """Self-healing gossip: if the peer has been silent past
+        gossip_stall_refresh_s AND is behind us, clear its delivery
+        bitmaps so both gossip routines re-send (see
+        PeerState.refresh_if_stalled). The behind-gate keeps a healthy
+        net that idles between txs quiet — peers at our height need
+        nothing re-sent (same-height wedges clear themselves through
+        round timeouts, which reset the per-round vote bitmaps via
+        NewRoundStep) — while the post-heal case this exists for (a
+        partitioned peer stuck below our height) always qualifies."""
+        if ps.prs.height >= self.cs.rs.height:
+            return
+        if ps.refresh_if_stalled(self.cs.config.gossip_stall_refresh_s):
+            m = self.cs.metrics
+            if m is not None:
+                m.gossip_peer_refreshes_total.inc()
+            self._wake_peer(ps.peer.id)
+
     async def _gossip_idle(self, waker: Optional[_Waker], sleep: float,
                            routine: str) -> None:
         """Idle until an event wakeup or the fallback sleep cap."""
@@ -478,6 +530,7 @@ class ConsensusReactor(Reactor):
         ps = self._peer_states.get(peer.id)
         if ps is None:
             return
+        ps.note_recv()
         rs = self.cs.rs
 
         if channel_id == STATE_CHANNEL:
@@ -611,6 +664,7 @@ class ConsensusReactor(Reactor):
         waker = self._wakers.get(peer.id, {}).get("data")
         try:
             while peer.is_running():
+                self._maybe_refresh_peer(ps)
                 rs = self.cs.rs
                 prs = ps.prs
 
@@ -692,6 +746,7 @@ class ConsensusReactor(Reactor):
         waker = self._wakers.get(peer.id, {}).get("votes")
         try:
             while peer.is_running():
+                self._maybe_refresh_peer(ps)
                 rs = self.cs.rs
                 prs = ps.prs
                 if rs.height == prs.height:

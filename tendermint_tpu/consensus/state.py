@@ -894,7 +894,7 @@ class ConsensusState:
 
         from ..libs.fail import fail_point
 
-        fail_point()  # (consensus/state.go:776 fail.Fail precommit->commit)
+        fail_point("consensus.commit.before_end_height")  # (consensus/state.go:776 fail.Fail precommit->commit)
         # EndHeight implies blockstore has the block (crash recovery pivot).
         self.wal.write_end_height(height, now_ns())
 

@@ -20,7 +20,9 @@ state transition can expose them to gossip (state.go:754,763).
 from __future__ import annotations
 
 import contextlib
+import errno
 import json
+import logging
 import os
 import struct
 import time
@@ -28,14 +30,34 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
+from ..libs.faults import faults
 from ..libs.trace import tracer
 from ..types.part_set import Part
 from ..types.proposal import Proposal
 from ..types.vote import Vote
 
+logger = logging.getLogger("tmtpu.wal")
+
 MAX_MSG_SIZE_BYTES = 1024 * 1024  # 1MB (wal.go maxMsgSizeBytes)
 DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # autofile group head rotation
 DEFAULT_GROUP_LIMIT = 60 * 1024 * 1024
+
+#: exit code for the fatal-fsync path (EX_IOERR from sysexits.h)
+FSYNC_EXIT_CODE = 74
+
+
+class FsyncError(BaseException):
+    """A WAL fsync failed: durability of already-written records is
+    UNKNOWN (fsyncgate: after a failed fsync the kernel may have dropped
+    the dirty pages, and a later successful fsync proves nothing about
+    them). BaseException on purpose — the consensus loop's defensive
+    ``except Exception`` must not be able to swallow it and carry on
+    treating the records as durable; like the reference's panic, the only
+    safe continuation is a restart that replays the WAL from disk."""
+
+
+def _injected_eio(site: str) -> OSError:
+    return OSError(errno.EIO, f"injected fault at {site}")
 
 
 @dataclass
@@ -90,6 +112,12 @@ class WAL:
     #: ConsensusMetrics (wal_fsyncs_total / wal_records_per_fsync /
     #: wal_fsync_seconds), wired by the node
     metrics = None
+    #: what to do when os.fsync raises (fsyncgate semantics — continuing
+    #: would record messages as durable that may not be): "exit" kills the
+    #: process (a node restart replays the WAL, the reference's panic
+    #: analog); "raise" surfaces FsyncError for in-process harnesses.
+    #: Env override TMTPU_FSYNC_ERROR_POLICY for subprocess nets.
+    fsync_error_policy = os.environ.get("TMTPU_FSYNC_ERROR_POLICY", "exit")
 
     def __init__(self, path: str, head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT):
         self.path = path
@@ -126,7 +154,11 @@ class WAL:
         n = self._records_since_sync
         with tracer.span("wal_fsync", n_records=n):
             t0 = time.perf_counter()
-            os.fsync(self._f.fileno())
+            try:
+                faults.inject("wal.fsync", _injected_eio)
+                os.fsync(self._f.fileno())
+            except OSError as e:
+                self._on_fsync_error(e)
             dt = time.perf_counter() - t0
         self._last_sync_t = time.monotonic()
         self._records_since_sync = 0
@@ -137,6 +169,21 @@ class WAL:
                 # no batch — only real record batches feed the histogram
                 m.wal_records_per_fsync.observe(n)
             m.wal_fsync_seconds.observe(dt)
+
+    def _on_fsync_error(self, e: OSError) -> None:
+        """Fatal by default: a record whose fsync failed must never be
+        treated as durable, and fsync retry semantics are untrustworthy
+        (fsyncgate) — so crash and let restart replay from disk."""
+        m = self.metrics
+        if m is not None:
+            m.wal_fsync_errors_total.inc()
+        logger.critical(
+            "WAL fsync failed (%s): %d record(s) of unknown durability; "
+            "%s per fsync_error_policy", e, self._records_since_sync,
+            "exiting" if self.fsync_error_policy == "exit" else "raising")
+        if self.fsync_error_policy == "raise":
+            raise FsyncError(f"WAL fsync failed: {e}") from e
+        os._exit(FSYNC_EXIT_CODE)
 
     @contextlib.contextmanager
     def group(self):

@@ -1,0 +1,121 @@
+"""Consensus stall watchdog: injected fault → observable degradation.
+
+The fault plane can blackhole a partition, open the device breaker, or
+kill fsync — but a node that silently stops committing is still an
+invisible failure unless something NOTICES. The watchdog samples the
+committed height; when it hasn't advanced for ``stall_timeout_s`` the node
+
+* increments ``consensus_stalled_total`` (the alertable signal),
+* writes a debugdump bundle (thread/task stacks, round state, peer table,
+  metrics snapshot — libs/debugdump.py) so the stall is diagnosable
+  post-mortem even if the operator only looks hours later,
+* logs CRITICAL with the stuck (height, round, step).
+
+One dump per stall episode: the watchdog re-arms only after the height
+moves again. Enabled via ``consensus.stall_watchdog_s`` (0 = off, the
+default — a chain configured to idle between txs would false-positive).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger("tmtpu.watchdog")
+
+
+class ConsensusWatchdog:
+    def __init__(self, cs, stall_timeout_s: float,
+                 metrics=None, dump_dir: Optional[str] = None,
+                 dump_node=None, check_interval_s: Optional[float] = None,
+                 height_fn=None):
+        """``cs`` is the ConsensusState to observe; ``metrics`` a
+        ConsensusMetrics (or None); ``dump_node`` whatever should be
+        handed to debugdump.write_dump (a Node, or a shim with
+        consensus_state/switch attributes, or None for stacks-only).
+        ``height_fn`` overrides the progress probe — the node passes the
+        block-store height, which advances during fast-sync too;
+        ConsensusState.state only moves after switch_to_consensus, so
+        sampling it alone would flag a >T-second block-sync as a stall."""
+        self.cs = cs
+        self._height_fn = height_fn
+        self.stall_timeout_s = stall_timeout_s
+        self.metrics = metrics
+        self.dump_dir = dump_dir
+        self.dump_node = dump_node
+        self.check_interval_s = (check_interval_s if check_interval_s
+                                 is not None
+                                 else max(0.25, stall_timeout_s / 4))
+        self.stalls = 0            # episodes observed (tests read this)
+        self.last_dump_path: Optional[str] = None
+        self._task: Optional[asyncio.Task] = None
+        self._last_height = -1
+        self._last_advance_t = 0.0
+        self._in_stall = False
+
+    async def start(self) -> None:
+        self._last_height = self._height()
+        self._last_advance_t = time.monotonic()
+        self._task = asyncio.create_task(self._run(),
+                                         name=f"cs-watchdog-{id(self)}")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def _height(self) -> int:
+        if self._height_fn is not None:
+            return self._height_fn()
+        return self.cs.state.last_block_height
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_interval_s)
+            h = self._height()
+            now = time.monotonic()
+            if h != self._last_height:
+                self._last_height = h
+                self._last_advance_t = now
+                if self._in_stall:
+                    logger.warning("consensus resumed at height %d after "
+                                   "stall", h)
+                    self._in_stall = False
+                continue
+            if (not self._in_stall
+                    and now - self._last_advance_t >= self.stall_timeout_s):
+                self._in_stall = True
+                self.stalls += 1
+                self._report(h, now - self._last_advance_t)
+
+    def _report(self, height: int, idle_s: float) -> None:
+        rs = getattr(self.cs, "rs", None)
+        logger.critical(
+            "consensus stalled: no commit for %.1fs (height=%d round=%s "
+            "step=%s)", idle_s, height,
+            getattr(rs, "round", "?"), getattr(rs, "step", "?"))
+        if self.metrics is not None:
+            self.metrics.consensus_stalled_total.inc()
+        if self.dump_dir:
+            try:
+                from ..libs.debugdump import write_dump
+
+                out = os.path.join(self.dump_dir,
+                                   f"debug-stall-{int(time.time())}")
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    loop = None
+                self.last_dump_path = write_dump(out, node=self.dump_node,
+                                                 loop=loop)
+                logger.critical("stall debugdump written to %s",
+                                self.last_dump_path)
+            except Exception:
+                logger.exception("failed writing stall debugdump")

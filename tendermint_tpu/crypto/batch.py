@@ -21,6 +21,7 @@ overridable for benchmarking. Set env TMTPU_BATCH_BACKEND to pin one.
 from __future__ import annotations
 
 import contextvars
+import logging
 import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -28,7 +29,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import Ed25519PubKey, PubKey
+from ..libs.faults import faults
 from ..libs.trace import tracer
+from .breaker import classify_device_error, device_breaker
+
+logger = logging.getLogger("tmtpu.batch")
 
 # below this many signatures the host scalar loop beats a device round-trip.
 # The break-even point depends on per-dispatch overhead: ~100 us on a local
@@ -81,7 +86,12 @@ def device_threshold() -> int:
                 DEFAULT_DEVICE_THRESHOLD,
                 int(overhead * _HOST_SIGS_PER_SEC_ESTIMATE
                     * _CALIBRATION_SAFETY))
-        except Exception:
+        except Exception as e:
+            # calibration failure is routing advice, not correctness: fall
+            # back to the static default — but say so, a silent except here
+            # once hid a broken relay for a whole bench run
+            logger.warning("device-threshold calibration failed (%s); "
+                           "using default %d", e, DEFAULT_DEVICE_THRESHOLD)
             _calibrated_threshold = DEFAULT_DEVICE_THRESHOLD
     return _calibrated_threshold
 
@@ -99,6 +109,9 @@ stats = {
     "device_batches": 0, "device_sigs": 0,
     "precomputed_batches": 0, "precomputed_sigs": 0,
     "largest_batch": 0,
+    # robustness plane: device attempts that raised (fell back to host) and
+    # batches the open circuit breaker kept off the device entirely
+    "device_errors": 0, "breaker_rejections": 0,
 }
 
 # CryptoMetrics hook, wired by the node (same idiom as p2p's
@@ -180,37 +193,82 @@ class BatchVerifier:
             thr = (self._threshold if self._threshold is not None
                    else device_threshold())
             backend = "jax" if n >= thr else "host"
+        if backend == "jax" and not device_breaker.allow():
+            # breaker OPEN: zero device attempts until the cooldown admits a
+            # half-open probe; the host path keeps verifying meanwhile
+            backend = "host"
+            stats["breaker_rejections"] += 1
+            if metrics is not None:
+                metrics.device_fallbacks_total.labels("breaker_open").inc()
 
         non_ed_idx = {i: pk for i, pk in non_ed}
-        stats["device_batches" if backend == "jax" else "host_batches"] += 1
-        stats["device_sigs" if backend == "jax" else "host_sigs"] += n
+
+        def _host_verify() -> np.ndarray:
+            res = np.zeros(n, dtype=bool)
+            for i in range(n):
+                pub = non_ed_idx.get(i) or Ed25519PubKey(pks[i])
+                res[i] = pub.verify_signature(msgs[i], sigs[i])
+            return res
+
         route = "device" if backend == "jax" else "scalar"
         t0 = time.perf_counter()
         # tracer.span is a shared no-op when disabled (one attribute check
         # inside span() plus the kwargs dict — noise next to any verify)
-        with tracer.span("batch_verify", n=n, route=route, plane=self.plane):
+        with tracer.span("batch_verify", n=n, route=route,
+                         plane=self.plane) as sp:
             if backend == "jax":
-                from .ed25519_jax import batch_verify_stream
+                try:
+                    # chaos seam: an armed `device.batch_verify` site raises
+                    # here, exercising the same fallback a real device error
+                    # takes
+                    faults.inject("device.batch_verify")
+                    from .ed25519_jax import batch_verify_stream
 
-                ed_pos = [i for i in range(n) if i not in non_ed_idx]
-                out = np.zeros(n, dtype=bool)
-                if ed_pos:
-                    # batch_verify_stream == batch_verify below one chunk;
-                    # above, it scans fixed-size chunks inside one device
-                    # execution
-                    ed_out = batch_verify_stream([pks[i] for i in ed_pos],
-                                                 [msgs[i] for i in ed_pos],
-                                                 [sigs[i] for i in ed_pos])
-                    out[ed_pos] = ed_out
-                # rare non-ed25519 keys verify on host, verdicts merged by
-                # index
-                for i, pub in non_ed_idx.items():
-                    out[i] = pub.verify_signature(msgs[i], sigs[i])
+                    ed_pos = [i for i in range(n) if i not in non_ed_idx]
+                    out = np.zeros(n, dtype=bool)
+                    if ed_pos:
+                        # batch_verify_stream == batch_verify below one
+                        # chunk; above, it scans fixed-size chunks inside
+                        # one device execution
+                        ed_out = batch_verify_stream(
+                            [pks[i] for i in ed_pos],
+                            [msgs[i] for i in ed_pos],
+                            [sigs[i] for i in ed_pos])
+                        out[ed_pos] = ed_out
+                    # rare non-ed25519 keys verify on host, verdicts merged
+                    # by index
+                    for i, pub in non_ed_idx.items():
+                        out[i] = pub.verify_signature(msgs[i], sigs[i])
+                except Exception as e:
+                    # a device failure never surfaces to the caller: the
+                    # batch re-verifies on host (byte-identical verdicts)
+                    # and the breaker remembers, so persistent failure stops
+                    # paying the device attempt at all
+                    reason = classify_device_error(e)
+                    logger.warning(
+                        "device batch verify failed (%s, n=%d, plane=%s): "
+                        "%s — re-verifying on host", reason, n, self.plane, e)
+                    device_breaker.record_failure()
+                    stats["device_errors"] += 1
+                    if metrics is not None:
+                        metrics.device_fallbacks_total.labels(reason).inc()
+                    route = "scalar"
+                    # keep the trace honest: the span was opened with
+                    # route="device" but the work below is the host path
+                    sp.set(route="scalar", device_error=reason)
+                    t0 = time.perf_counter()  # charge only the host verify
+                    out = _host_verify()
+                else:
+                    if ed_pos:
+                        # only real device evidence closes/holds the
+                        # breaker: an all-non-ed25519 batch never touched
+                        # the device, and letting it report success would
+                        # falsely close a half-open probe
+                        device_breaker.record_success()
             else:
-                out = np.zeros(n, dtype=bool)
-                for i in range(n):
-                    pub = non_ed_idx.get(i) or Ed25519PubKey(pks[i])
-                    out[i] = pub.verify_signature(msgs[i], sigs[i])
+                out = _host_verify()
+        stats["device_batches" if route == "device" else "host_batches"] += 1
+        stats["device_sigs" if route == "device" else "host_sigs"] += n
         if metrics is not None:
             elapsed = time.perf_counter() - t0
             metrics.routing_decisions_total.labels(route, self.plane).inc()

@@ -32,8 +32,10 @@ import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..libs.faults import faults
 from ..libs.trace import tracer
 from . import batch as _batch  # module ref: reads the live metrics hook
+from .breaker import classify_device_error, device_breaker
 
 logger = logging.getLogger("tmtpu.votebatch")
 
@@ -143,16 +145,34 @@ class BatchVoteVerifier:
             return [Ed25519PubKey(pk).verify_signature(m, s)
                     for _key, pk, m, s, _fut in batch]
 
+        use_device = n >= self.min_device_batch and not self._device_warming
+        if use_device and not device_breaker.allow():
+            # breaker OPEN (shared with BatchVerifier): no device attempt,
+            # the host scalar path keeps the vote plane verifying
+            use_device = False
+            self.stats["breaker_rejections"] += 1
+            if cm is not None:
+                cm.device_fallbacks_total.labels("breaker_open").inc()
         try:
-            if n >= self.min_device_batch and not self._device_warming:
-                from .ed25519_jax import batch_verify_stream
-
+            if use_device:
                 route = "device"
                 pks = [b[1] for b in batch]
                 msgs = [b[2] for b in batch]
                 sigs = [b[3] for b in batch]
-                dev = loop.run_in_executor(
-                    None, batch_verify_stream, pks, msgs, sigs)
+
+                def _device_verify():
+                    # chaos seam: an armed `device.vote_flush` site raises
+                    # on the executor thread, exactly where a real kernel /
+                    # relay failure would surface. The ed25519_jax import
+                    # lives here too so a broken jax install takes the same
+                    # host-fallback + breaker path as a runtime failure
+                    # instead of failing every pending preverify future
+                    faults.inject("device.vote_flush")
+                    from .ed25519_jax import batch_verify_stream
+
+                    return batch_verify_stream(pks, msgs, sigs)
+
+                dev = loop.run_in_executor(None, _device_verify)
                 try:
                     out = await asyncio.wait_for(
                         asyncio.shield(dev), self.device_timeout_s)
@@ -164,6 +184,7 @@ class BatchVoteVerifier:
                     # now; let the (probably compiling) device call finish
                     # in the background and re-enable the device path then
                     self._device_warming = True
+                    device_breaker.record_failure()
 
                     def _device_ready(f):
                         self._device_warming = False
@@ -183,7 +204,25 @@ class BatchVoteVerifier:
                         cm.device_fallbacks_total.labels(
                             "device_timeout").inc()
                     results = await loop.run_in_executor(None, _host_verify)
+                except Exception as e:
+                    # device call FAILED (not merely slow): re-verify this
+                    # batch on host — verdicts stay byte-identical, no
+                    # pending preverify future is ever failed by a device
+                    # error — and feed the breaker
+                    route = "scalar"
+                    t_v0 = time.perf_counter()
+                    reason = classify_device_error(e)
+                    logger.warning("device vote flush failed (%s, n=%d): %s "
+                                   "— re-verifying on host", reason, n, e)
+                    device_breaker.record_failure()
+                    self.stats["device_errors"] += 1
+                    self.stats["host_batches"] += 1
+                    self.stats["host_sigs"] += n
+                    if cm is not None:
+                        cm.device_fallbacks_total.labels(reason).inc()
+                    results = await loop.run_in_executor(None, _host_verify)
                 else:
+                    device_breaker.record_success()
                     self.stats["device_batches"] += 1
                     self.stats["device_sigs"] += n
                     results = [bool(v) for v in out]

@@ -48,6 +48,11 @@ class NodeManifest:
     start_at: int = 0                  # 0 = start with the net
     perturb: List[str] = field(default_factory=list)
     misbehaviors: Dict[int, str] = field(default_factory=dict)
+    # fault-plane arming for this node's subprocess: exported as
+    # TMTPU_FAULTS / TMTPU_FAULTS_SEED (libs/faults.py grammar), e.g.
+    # faults = "wal.fsync*1+3" crashes the node at its 4th fsync
+    faults: str = ""
+    faults_seed: int = 0
 
     def validate(self) -> None:
         if self.mode not in ("validator", "full"):
@@ -60,6 +65,20 @@ class NodeManifest:
         for p in self.perturb:
             if p not in ("kill", "pause", "restart", "disconnect"):
                 raise ValueError(f"{self.name}: unknown perturbation {p!r}")
+        if self.faults:
+            from ..libs.faults import KNOWN_SITES, FaultPlane
+
+            try:  # fail at manifest load, not node boot
+                plane = FaultPlane().configure(self.faults, self.faults_seed)
+            except ValueError as e:
+                raise ValueError(f"{self.name}: bad faults spec: {e}") from e
+            unknown = set(plane.counts()) - KNOWN_SITES
+            if unknown:
+                # a typo'd site arms nothing and the chaos run passes
+                # vacuously — reject it where the operator can see it
+                raise ValueError(
+                    f"{self.name}: unknown fault site(s) {sorted(unknown)}; "
+                    f"known: {sorted(KNOWN_SITES)}")
         if self.state_sync and self.start_at == 0:
             raise ValueError(
                 f"{self.name}: state_sync nodes must join later (start_at > 0)")
@@ -95,6 +114,8 @@ class Manifest:
                 perturb=list(nd.get("perturb", [])),
                 misbehaviors={int(h): m
                               for h, m in nd.get("misbehaviors", {}).items()},
+                faults=nd.get("faults", ""),
+                faults_seed=int(nd.get("faults_seed", 0)),
             ))
         m = cls(
             chain_id=doc.get("chain_id", "e2e-net"),

@@ -127,6 +127,14 @@ class Runner:
             env["TMTPU_MISBEHAVIORS"] = ",".join(
                 f"{h}:{b}" for h, b in sorted(nm.misbehaviors.items()))
             env["TMTPU_UNSAFE_PV"] = "1"
+        if nm.faults:
+            # arm the node's fault plane (libs/faults.py reads these at
+            # import, so the subprocess starts with the sites live)
+            env["TMTPU_FAULTS"] = nm.faults
+            env["TMTPU_FAULTS_SEED"] = str(nm.faults_seed)
+        # stall watchdog: an e2e node that silently stops committing should
+        # leave a debugdump bundle behind, not just a hung run
+        env.setdefault("TMTPU_STALL_WATCHDOG_S", "60")
         return env
 
     def _launch(self, nm: NodeManifest) -> None:

@@ -9,9 +9,16 @@ iteration and atomic write batches, which is all the stores need.
 from __future__ import annotations
 
 import bisect
+import errno
 import sqlite3
 import threading
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from .faults import faults
+
+
+def _injected_db_fault(site: str) -> OSError:
+    return OSError(errno.EIO, f"injected fault at {site}")
 
 
 class DB:
@@ -109,6 +116,13 @@ class BufferedDB(DB):
         return len(self._sets) + len(self._dels)
 
     def flush(self) -> None:
+        """Apply the staged window as one base write_batch. fsyncgate
+        semantics: a failed flush raises WITHOUT clearing the staged
+        writes — the records were handled by the app but are NOT durable,
+        and silently dropping them here is exactly the
+        handled-but-not-durable hole the chaos suite hunts. Callers treat
+        the error as fatal (blockchain reactor → on_fatal) or retry the
+        flush; injectable at the base DB's ``db.write_batch`` site."""
         from .trace import tracer
 
         if self._sets or self._dels:
@@ -157,6 +171,9 @@ class MemDB(DB):
                 yield k, v
 
     def write_batch(self, sets, deletes=None) -> None:
+        # chaos site shared with SQLiteDB: a fired fault applies NOTHING
+        # (all-or-nothing, like the sqlite transaction)
+        faults.inject("db.write_batch", _injected_db_fault)
         with self._lock:
             for k, v in sets:
                 self.set(k, v)
@@ -211,6 +228,10 @@ class SQLiteDB(DB):
             yield bytes(k), bytes(v)
 
     def write_batch(self, sets, deletes=None) -> None:
+        # same chaos site as BufferedDB.flush: the injection lands BEFORE
+        # the transaction so a fired fault applies nothing (the sqlite
+        # transaction itself already guarantees all-or-nothing)
+        faults.inject("db.write_batch", _injected_db_fault)
         with self._lock:
             self._conn.executemany(
                 "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",

@@ -3,14 +3,33 @@ sprinkled with ``fail_point()`` calls; setting ``TMTPU_FAIL_INDEX=N`` kills
 the process at the Nth point reached, so crash-consistency tests can murder
 a node at every interesting boundary (reference sites:
 state/execution.go:149,156,188,196, consensus/state.go:776).
+
+Two trigger forms:
+
+* index — ``TMTPU_FAIL_INDEX=N``: die at the Nth fail point reached,
+  whichever it is (the crash-matrix sweep);
+* named — ``TMTPU_FAIL_POINT=<site>``: die the first time the point with
+  that name is reached (``fail_point("consensus.commit.before_end_height")``),
+  so a test can target one boundary without counting its way there.
+
+The counter is lock-protected: fail points sit on the consensus loop AND
+on apply-plane worker threads, and a racy double-increment would make the
+crash matrix skip boundaries. Test fixtures call :func:`reset` so
+counters don't leak between tests (see tests/conftest.py).
+
+For non-fatal, probabilistic, seeded injection see libs/faults.py — this
+module is only the kill switch.
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import threading
+from typing import Optional
 
 _counter = 0
+_lock = threading.Lock()
 
 
 def fail_index() -> int:
@@ -18,19 +37,35 @@ def fail_index() -> int:
     return int(v) if v else -1
 
 
-def fail_point() -> None:
-    """(fail.go Fail) exit(1) when the configured index is reached."""
+def fail_point(name: Optional[str] = None) -> None:
+    """(fail.go Fail) exit(1) when the configured index — or, for named
+    points, the configured TMTPU_FAIL_POINT site — is reached."""
     global _counter
+    named = os.environ.get("TMTPU_FAIL_POINT")
+    if named and name is not None and named == name:
+        _die(f"named fail point {name!r} reached")
     idx = fail_index()
     if idx < 0:
         return
-    if _counter == idx:
-        sys.stderr.write(f"*** fail point {idx} reached: exiting ***\n")
-        sys.stderr.flush()
-        os._exit(1)
-    _counter += 1
+    with _lock:
+        hit = _counter == idx
+        _counter += 1
+    if hit:
+        _die(f"fail point {idx} reached")
+
+
+def _die(why: str) -> None:
+    sys.stderr.write(f"*** {why}: exiting ***\n")
+    sys.stderr.flush()
+    os._exit(1)
 
 
 def reset() -> None:
     global _counter
-    _counter = 0
+    with _lock:
+        _counter = 0
+
+
+def counter() -> int:
+    with _lock:
+        return _counter

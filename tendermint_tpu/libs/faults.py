@@ -1,0 +1,256 @@
+"""Deterministic, seeded fault injection — the fault plane.
+
+``libs/fail.py`` can only murder the process at a counter; real failure
+modes are partial: a device call that raises, an fsync that returns EIO, a
+link that eats a packet. This module gives every such failure surface a
+NAMED SITE that production code consults in one call:
+
+    from ..libs.faults import faults
+    faults.inject("wal.fsync", _EIO)     # raises iff the site is armed
+
+Sites ship disabled: ``faults`` is a singleton whose hot-path check is one
+truthiness test on an empty dict, so instrumented code pays nothing in
+production. Arming happens from the environment::
+
+    TMTPU_FAULTS="wal.fsync*1+2,device.batch_verify@0.25"
+    TMTPU_FAULTS_SEED=7
+
+or programmatically (tests): ``faults.configure("db.write_batch*1")``.
+
+Grammar — comma-separated site specs, each ``site[@prob][*count][+skip]``:
+
+* ``site``        fire on every evaluation (prob 1, unlimited)
+* ``site@0.1``    fire with probability 0.1 per evaluation
+* ``site*3``      fire at most 3 times, then go quiet
+* ``site+5``      skip the first 5 evaluations before arming
+* modifiers combine: ``wal.fsync@0.5*2+1``
+
+Determinism: each site draws from its own ``random.Random`` seeded by
+(global seed, site name), so a failing chaos run replays EXACTLY by
+re-running with the same TMTPU_FAULTS/TMTPU_FAULTS_SEED pair — regardless
+of how other sites interleave or what order threads evaluate. All state is
+lock-protected; sites are evaluated from reactor tasks, executor threads,
+and the consensus loop alike.
+
+Known sites (the catalog; see README "Fault injection & chaos testing"):
+
+* ``device.batch_verify`` — BatchVerifier's device dispatch (crypto/batch.py)
+* ``device.vote_flush``   — vote micro-batcher device flush (vote_batcher.py)
+* ``wal.fsync``           — consensus WAL fsync (consensus/wal.py)
+* ``db.write_batch``      — KV write batches: BufferedDB window flush and
+                            SQLiteDB write_batch (libs/db.py)
+* ``net.drop``            — in-proc transport delivery (p2p/inproc.py)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional
+
+ENV_SPEC = "TMTPU_FAULTS"
+ENV_SEED = "TMTPU_FAULTS_SEED"
+
+#: every site production code actually consults (the docstring catalog).
+#: Site names are intentionally open — tests arm ad-hoc names — but a
+#: typo'd name in an operator-facing spec arms nothing and the chaos run
+#: passes vacuously, so env/manifest arming validates against this.
+KNOWN_SITES = frozenset({
+    "device.batch_verify",
+    "device.vote_flush",
+    "wal.fsync",
+    "db.write_batch",
+    "net.drop",
+})
+
+logger = logging.getLogger("tmtpu.faults")
+
+
+class InjectedFault(Exception):
+    """Raised by an armed site with no caller-supplied exception factory."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at {site!r}")
+        self.site = site
+
+
+class _Site:
+    __slots__ = ("name", "prob", "count", "skip", "rng", "evals", "fires")
+
+    def __init__(self, name: str, prob: float, count: Optional[int],
+                 skip: int, seed: int):
+        self.name = name
+        self.prob = prob
+        self.count = count          # None = unlimited
+        self.skip = skip
+        # per-site stream: other sites' draws can't perturb this one's
+        self.rng = random.Random(zlib.crc32(f"{seed}|{name}".encode()))
+        self.evals = 0
+        self.fires = 0
+
+    def evaluate(self) -> bool:
+        self.evals += 1
+        if self.evals <= self.skip:
+            return False
+        if self.count is not None and self.fires >= self.count:
+            return False
+        if self.prob < 1.0 and self.rng.random() >= self.prob:
+            return False
+        self.fires += 1
+        return True
+
+
+def _parse_spec(spec: str, seed: int) -> Dict[str, _Site]:
+    sites: Dict[str, _Site] = {}
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        name, prob, count, skip = raw, 1.0, None, 0
+        # modifiers may appear in any order; the site name is the prefix up
+        # to the first marker, then the modifier tail is walked char-wise
+        first = min((i for i in (raw.find(m) for m in "@*+") if i >= 0),
+                    default=-1)
+        if first >= 0:
+            name, tail = raw[:first], raw[first:]
+            i = 0
+            try:
+                while i < len(tail):
+                    marker = tail[i]
+                    j = i + 1
+                    while j < len(tail) and tail[j] not in "@*+":
+                        j += 1
+                    val = tail[i + 1:j]
+                    if marker == "@":
+                        prob = float(val)
+                    elif marker == "*":
+                        count = int(val)
+                    elif marker == "+":
+                        skip = int(val)
+                    i = j
+            except ValueError as e:
+                raise ValueError(f"bad fault spec {raw!r}: {e}") from e
+        if not name:
+            raise ValueError(f"bad fault spec {raw!r}: empty site name")
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"bad fault spec {raw!r}: prob not in [0,1]")
+        if (count is not None and count < 0) or skip < 0:
+            raise ValueError(f"bad fault spec {raw!r}: negative count/skip")
+        sites[name] = _Site(name, prob, count, skip, seed)
+    return sites
+
+
+# FaultMetrics (faults_injected_total{site}), wired by the node; None for
+# library users — one None-check per FIRE, not per evaluation
+metrics = None
+
+
+def set_fault_metrics(m) -> None:
+    global metrics
+    metrics = m
+
+
+class FaultPlane:
+    """Singleton holding every armed site. Disabled == empty == free."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sites: Dict[str, _Site] = {}
+        self._spec = ""
+        self._seed = 0
+
+    # -- configuration -----------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._sites)
+
+    @property
+    def spec(self) -> str:
+        return self._spec
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def configure(self, spec: str, seed: int = 0) -> "FaultPlane":
+        """Arm sites from a spec string (see module grammar). Replaces any
+        previous configuration; returns self for chaining."""
+        parsed = _parse_spec(spec, seed)
+        with self._lock:
+            self._sites = parsed
+            self._spec = spec
+            self._seed = seed
+        return self
+
+    def configure_from_env(self, environ=os.environ) -> "FaultPlane":
+        spec = environ.get(ENV_SPEC, "")
+        if spec:
+            self.configure(spec, int(environ.get(ENV_SEED, "0") or "0"))
+            unknown = set(self._sites) - KNOWN_SITES
+            if unknown:
+                logger.warning(
+                    "%s arms site(s) no production code consults: %s — "
+                    "known sites: %s", ENV_SPEC, sorted(unknown),
+                    sorted(KNOWN_SITES))
+        return self
+
+    def reset(self) -> None:
+        """Disarm every site (test fixtures call this between tests)."""
+        with self._lock:
+            self._sites = {}
+            self._spec = ""
+            self._seed = 0
+
+    # -- evaluation (the production seam) ----------------------------------
+
+    def armed(self, site: str) -> bool:
+        """Lock-free membership probe for hot paths that want to skip
+        ``fire``'s lock when the site isn't configured at all. Safe:
+        ``_sites`` is replaced wholesale under configure/reset, and a dict
+        membership test is atomic under the GIL."""
+        return site in self._sites
+
+    def fire(self, site: str) -> bool:
+        """Evaluate one trigger at `site`; True when the fault should
+        happen. The disabled fast path is a single dict-truthiness check."""
+        if not self._sites:
+            return False
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None or not st.evaluate():
+                return False
+        m = metrics
+        if m is not None:
+            m.faults_injected_total.labels(site).inc()
+        return True
+
+    def inject(self, site: str,
+               exc_factory: Optional[Callable[[str], BaseException]] = None
+               ) -> None:
+        """Raise at `site` when armed; no-op otherwise. ``exc_factory``
+        builds the exception (default: InjectedFault) so storage sites can
+        surface an OSError exactly like the real failure would."""
+        if self.fire(site):
+            raise (exc_factory(site) if exc_factory is not None
+                   else InjectedFault(site))
+
+    # -- introspection (tests / tools) -------------------------------------
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {name: {"evals": s.evals, "fires": s.fires}
+                    for name, s in self._sites.items()}
+
+    def fires(self, site: str) -> int:
+        with self._lock:
+            s = self._sites.get(site)
+            return s.fires if s is not None else 0
+
+
+#: process-wide singleton; armed from the environment at import so
+#: subprocess nodes (e2e runner, cmd start) inherit TMTPU_FAULTS for free
+faults = FaultPlane().configure_from_env()

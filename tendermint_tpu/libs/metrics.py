@@ -325,6 +325,20 @@ class ConsensusMetrics:
             "consensus", "wal_fsync_seconds", "WAL fsync latency.",
             buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                      0.01, 0.025, 0.05, 0.1))
+        # -- robustness plane (fault injection / watchdog) ---------------
+        self.wal_fsync_errors_total = c(
+            "consensus", "wal_fsync_errors_total",
+            "WAL fsync calls that failed (fatal per fsync_error_policy).")
+        # attribute keeps the catalog name; the series is
+        # tendermint_consensus_stalled_total (subsystem supplies the prefix)
+        self.consensus_stalled_total = c(
+            "consensus", "stalled_total",
+            "Stall episodes: no committed-height advance for "
+            "stall_watchdog_s.")
+        self.gossip_peer_refreshes_total = c(
+            "consensus", "gossip_peer_refreshes_total",
+            "Silent-peer delivery bitmaps cleared for re-gossip "
+            "(gossip_stall_refresh_s).")
 
 
 class MempoolMetrics:
@@ -406,6 +420,26 @@ class CryptoMetrics:
             "crypto", "vote_flush_latency_seconds",
             "Vote micro-batch flush latency.", ["route"],
             buckets=self.LATENCY_BUCKETS)
+        # -- device circuit breaker (crypto/breaker.py) ------------------
+        self.breaker_state = g(
+            "crypto", "breaker_state",
+            "Circuit breaker state: 0 closed, 1 open, 2 half-open.",
+            ["breaker"])
+        self.breaker_transitions_total = c(
+            "crypto", "breaker_transitions_total",
+            "Circuit breaker state transitions.",
+            ["breaker", "from", "to"])
+
+
+class FaultMetrics:
+    """The fault-injection plane (libs/faults.py): how many injected
+    faults actually fired, per site — the denominator every chaos
+    assertion divides by."""
+
+    def __init__(self, reg: Registry):
+        self.faults_injected_total = reg.counter(
+            "faults", "injected_total",
+            "Injected faults fired, per site.", ["site"])
 
 
 class BlocksyncMetrics:
@@ -453,3 +487,4 @@ class NodeMetrics:
         self.state = StateMetrics(self.registry)
         self.crypto = CryptoMetrics(self.registry)
         self.blocksync = BlocksyncMetrics(self.registry)
+        self.faults = FaultMetrics(self.registry)

@@ -47,6 +47,9 @@ class _NoopSpan:
     def __exit__(self, *exc) -> None:
         return None
 
+    def set(self, **args) -> "_NoopSpan":
+        return self
+
 
 _NOOP_SPAN = _NoopSpan()
 
@@ -61,6 +64,14 @@ class _Span:
 
     def __enter__(self) -> "_Span":
         self._t0 = time.perf_counter()
+        return self
+
+    def set(self, **args) -> "_Span":
+        """Amend the span's args mid-body (e.g. the route actually taken
+        when a device attempt fell back to host)."""
+        if self._args is None:
+            self._args = {}
+        self._args.update(args)
         return self
 
     def __exit__(self, *exc) -> None:

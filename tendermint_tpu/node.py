@@ -214,6 +214,34 @@ class Node:
 
         set_crypto_metrics(self.metrics.crypto)
         self.blockchain_reactor.metrics = self.metrics.blocksync
+        # robustness plane: breaker state/transitions onto the crypto set,
+        # fault-plane fire counts onto their own subsystem
+        from .crypto.breaker import set_breaker_metrics
+        from .libs.faults import set_fault_metrics
+
+        set_breaker_metrics(self.metrics.crypto)
+        set_fault_metrics(self.metrics.faults)
+
+        # consensus stall watchdog (config.consensus.stall_watchdog_s > 0,
+        # or TMTPU_STALL_WATCHDOG_S for subprocess nets — e2e runner sets
+        # it): no committed-height advance for T seconds →
+        # consensus_stalled_total + a debugdump bundle under the node home
+        self._watchdog = None
+        stall_s = float(os.environ.get("TMTPU_STALL_WATCHDOG_S")
+                        or config.consensus.stall_watchdog_s)
+        if stall_s > 0:
+            from .consensus.watchdog import ConsensusWatchdog
+
+            self._watchdog = ConsensusWatchdog(
+                self.consensus_state, stall_s,
+                metrics=self.metrics.consensus, dump_dir=config.root_dir,
+                dump_node=self,
+                # block-store height advances during fast-sync AND on every
+                # consensus commit — a late joiner block-syncing for longer
+                # than stall_s is progress, not a stall
+                height_fn=lambda: max(
+                    self.block_store.height(),
+                    self.consensus_state.state.last_block_height))
 
         # -- tx/block indexer (node.go:745 createAndStartIndexerService) ----
         self.indexer_service = None
@@ -385,6 +413,8 @@ class Node:
                            self.consensus_state.rs.height)
             await self.consensus_state.start()
         # (fast-sync case: Switch.start() already started the reactor)
+        if self._watchdog is not None:
+            await self._watchdog.start()
         if self.config.p2p.persistent_peers:
             peers = parse_peer_list(self.config.p2p.persistent_peers)
             self.switch.dial_peers_async(peers, persistent=True)
@@ -447,6 +477,8 @@ class Node:
         task = getattr(self, "_statesync_task", None)
         if task is not None and not task.done():
             task.cancel()
+        if self._watchdog is not None:
+            await self._watchdog.stop()
         await self.consensus_state.stop()
         if self.indexer_service is not None:
             await self.indexer_service.stop()

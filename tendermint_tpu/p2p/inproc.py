@@ -1,18 +1,85 @@
 """In-process transport: whole multi-node networks in one asyncio loop
 (the reference's p2p test utilities — MakeConnectedSwitches over net.Pipe,
 p2p/test_util.go). The production TCP transport shares the Peer surface.
+
+Chaos controls: every DIRECTED link (a's peer object for b carries the
+a→b direction) can take a :class:`LinkPolicy` — seeded drop / duplicate /
+reorder / delay plus a partition blackhole — so a 4-node consensus net can
+be run under deterministic 10% loss, partitioned, and healed, all inside
+one test. Policies are applied at ``try_send`` time; with no policy the
+path is byte-identical to the original direct enqueue.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
-from typing import Dict, Optional, Tuple
+import random
+import zlib
+from typing import Dict, Iterable, Optional, Set, Tuple
 
+from ..libs.faults import faults
 from .base import Peer
 from .switch import Switch
 
 logger = logging.getLogger("tmtpu.p2p.inproc")
+
+
+class LinkPolicy:
+    """Deterministic chaos policy for one directed link.
+
+    All randomness comes from one ``random.Random`` seeded by
+    (seed, src, dst), so a run replays exactly: the i-th send over this
+    link sees the same fate every time regardless of scheduling elsewhere.
+    ``blocked`` models a network partition: sends are blackholed (the
+    sender still sees success — a partitioned wire gives no feedback).
+    """
+
+    __slots__ = ("drop_p", "dup_p", "reorder_p", "delay_s", "blocked",
+                 "rng", "stats")
+
+    def __init__(self, src: str = "", dst: str = "", seed: int = 0,
+                 drop_p: float = 0.0, dup_p: float = 0.0,
+                 reorder_p: float = 0.0, delay_s: float = 0.0,
+                 blocked: bool = False):
+        self.drop_p = drop_p
+        self.dup_p = dup_p
+        self.reorder_p = reorder_p
+        self.delay_s = delay_s
+        self.blocked = blocked
+        self.rng = random.Random(zlib.crc32(f"{seed}|{src}|{dst}".encode()))
+        self.stats = collections.Counter()
+
+    def plan(self) -> Optional[list]:
+        """Decide one message's fate: None = drop/blackhole, else a list of
+        per-copy delivery delays (0.0 = immediate). Pure decision — the
+        peer does the queueing — so determinism is testable without a
+        loop."""
+        if self.blocked:
+            self.stats["blackholed"] += 1
+            return None
+        r = self.rng
+        if self.drop_p and r.random() < self.drop_p:
+            self.stats["dropped"] += 1
+            return None
+        copies = 1
+        if self.dup_p and r.random() < self.dup_p:
+            copies = 2
+            self.stats["duplicated"] += 1
+        delays = []
+        for _ in range(copies):
+            delay = self.delay_s
+            if self.reorder_p and r.random() < self.reorder_p:
+                # hold this copy just long enough for later sends to
+                # overtake it (queue pumps drain in well under a ms)
+                delay += r.uniform(0.001, 0.005)
+                self.stats["reordered"] += 1
+            if delay:
+                self.stats["delayed"] += 1
+            delays.append(delay)
+        self.stats["delivered"] += copies
+        return delays
 
 
 class InProcPeer(Peer):
@@ -24,6 +91,9 @@ class InProcPeer(Peer):
         self._recv_queue: "asyncio.Queue[Tuple[int, bytes]]" = asyncio.Queue(maxsize=10000)
         self._running = True
         self._pump_task: Optional[asyncio.Task] = None
+        #: chaos policy for the direction this peer object sends in
+        #: (owner → remote); None = the original zero-overhead path
+        self.policy: Optional[LinkPolicy] = None
 
     def send(self, channel_id: int, msg: bytes) -> bool:
         return self.try_send(channel_id, msg)
@@ -31,11 +101,47 @@ class InProcPeer(Peer):
     def try_send(self, channel_id: int, msg: bytes) -> bool:
         if not self._running or self._remote is None:
             return False
+        pol = self.policy
+        if pol is None and not faults.enabled:
+            return self._deliver(channel_id, msg)
+        # generic env-armed site (TMTPU_FAULTS=net.drop@p): drops ride the
+        # same path as a policy drop. The lock-free armed() probe keeps
+        # chaos runs arming only storage/device sites off fire()'s lock on
+        # this per-message path
+        if faults.armed("net.drop") and faults.fire("net.drop"):
+            return True
+        if pol is None:
+            return self._deliver(channel_id, msg)
+        delays = pol.plan()
+        if delays is None:
+            return True  # dropped/blackholed: the wire gives no feedback
+        ok = True
+        for delay in delays:
+            if delay <= 0.0:
+                ok = self._deliver(channel_id, msg) and ok
+            else:
+                self._deliver_later(delay, channel_id, msg)
+        return ok
+
+    def _deliver(self, channel_id: int, msg: bytes) -> bool:
         try:
             self._remote._recv_queue.put_nowait((channel_id, msg))
             return True
         except asyncio.QueueFull:
             return False
+
+    def _deliver_later(self, delay: float, channel_id: int, msg: bytes) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._deliver(channel_id, msg)  # no loop: deliver inline
+            return
+
+        def _fire():
+            if self._running and self._remote is not None:
+                self._deliver(channel_id, msg)
+
+        loop.call_later(delay, _fire)
 
     def is_running(self) -> bool:
         return self._running
@@ -62,6 +168,9 @@ class InProcNetwork:
 
     def __init__(self):
         self.switches: Dict[str, Switch] = {}
+        #: directed links: (src node, dst node) -> the src-owned peer
+        #: object whose try_send covers that direction
+        self.links: Dict[Tuple[str, str], InProcPeer] = {}
 
     def add_switch(self, switch: Switch) -> None:
         self.switches[switch.node_id] = switch
@@ -75,6 +184,8 @@ class InProcNetwork:
         peer_of_a._remote = peer_of_b
         peer_of_b._pump_task = asyncio.create_task(peer_of_b._pump(sw_a))
         peer_of_a._pump_task = asyncio.create_task(peer_of_a._pump(sw_b))
+        self.links[(id_a, id_b)] = peer_of_b
+        self.links[(id_b, id_a)] = peer_of_a
         await sw_a.add_peer(peer_of_b)
         await sw_b.add_peer(peer_of_a)
 
@@ -89,10 +200,62 @@ class InProcNetwork:
         sw_a, sw_b = self.switches[id_a], self.switches[id_b]
         pa = sw_a.peers.get(id_b)
         pb = sw_b.peers.get(id_a)
+        self.links.pop((id_a, id_b), None)
+        self.links.pop((id_b, id_a), None)
         if pa is not None:
             await sw_a.stop_peer_gracefully(pa)
         if pb is not None:
             await sw_b.stop_peer_gracefully(pb)
+
+    # -- chaos controls ------------------------------------------------------
+
+    def set_link_policy(self, src: str, dst: str, seed: int = 0,
+                        **kw) -> LinkPolicy:
+        """Attach a fresh seeded policy to the directed link src→dst."""
+        peer = self.links[(src, dst)]
+        peer.policy = LinkPolicy(src, dst, seed=seed, **kw)
+        return peer.policy
+
+    def set_loss(self, drop_p: float, seed: int = 0, **kw) -> None:
+        """Seeded loss (plus any other policy knobs) on EVERY directed
+        link. Per-link RNGs derive from (seed, src, dst), so the whole-net
+        schedule replays exactly for a given seed."""
+        for (src, dst) in list(self.links):
+            self.set_link_policy(src, dst, seed=seed, drop_p=drop_p, **kw)
+
+    def clear_policies(self) -> None:
+        for peer in self.links.values():
+            peer.policy = None
+
+    def partition(self, group_a: Iterable[str],
+                  group_b: Optional[Iterable[str]] = None) -> None:
+        """Blackhole every link crossing the cut (both directions).
+        ``group_b`` defaults to all other switches. Existing policies on
+        crossing links keep their seed/loss knobs and gain ``blocked``;
+        links without a policy get a block-only one."""
+        a: Set[str] = set(group_a)
+        b: Set[str] = (set(group_b) if group_b is not None
+                       else set(self.switches) - a)
+        for (src, dst), peer in self.links.items():
+            if (src in a and dst in b) or (src in b and dst in a):
+                if peer.policy is None:
+                    peer.policy = LinkPolicy(src, dst, blocked=True)
+                else:
+                    peer.policy.blocked = True
+
+    def heal(self) -> None:
+        """Unblock every partitioned link (loss/delay knobs survive)."""
+        for peer in self.links.values():
+            if peer.policy is not None:
+                peer.policy.blocked = False
+
+    def chaos_stats(self) -> collections.Counter:
+        """Aggregate per-link policy counters (dropped/duplicated/...)."""
+        total: collections.Counter = collections.Counter()
+        for peer in self.links.values():
+            if peer.policy is not None:
+                total.update(peer.policy.stats)
+        return total
 
     async def stop(self) -> None:
         for sw in self.switches.values():

@@ -112,7 +112,7 @@ class BlockExecutor:
 
         _t0 = _time.perf_counter()
         self.validate_block(state, block)
-        fail_point()  # (execution.go:149)
+        fail_point("execution.before_exec_block")  # (execution.go:149)
 
         abci_responses = exec_block_on_proxy_app(
             self.proxy_app, block, self.state_store, state.initial_height)
@@ -136,7 +136,7 @@ class BlockExecutor:
         new_state.app_hash = app_hash
         self.state_store.save(new_state)
 
-        fail_point()  # (execution.go:196)
+        fail_point("execution.after_state_save")  # (execution.go:196)
         if self.event_bus is not None:
             fire_events(self.event_bus, block, block_id, abci_responses, validator_updates)
 

@@ -161,8 +161,11 @@ class StateStore:
         try:
             yield self
         finally:
-            self._db = buf.base
+            # flush BEFORE unhooking: a failed flush keeps the staged
+            # window reachable as self._db (no silent drop of records the
+            # app already handled)
             buf.flush()
+            self._db = buf.base
 
     # -- state --
 

@@ -141,8 +141,12 @@ class BlockStore:
             yield self
         finally:
             with self._mtx:
-                self._db = buf.base
+                # flush BEFORE unhooking: on a flush fault (injected or
+                # real EIO) the staged window stays reachable as self._db,
+                # so reads remain consistent with the handled-but-not-yet-
+                # durable state while the fatal handler runs
                 buf.flush()
+                self._db = buf.base
 
     def save_block(self, block: Block, block_parts: PartSet, seen_commit: Commit) -> None:
         """(store/store.go:332 SaveBlock)"""

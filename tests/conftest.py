@@ -15,7 +15,27 @@ Set TM_ON_DEVICE=1 to skip the pin and run the on-device differential suite
 
 import os
 
+import pytest
+
 ON_DEVICE = os.environ.get("TM_ON_DEVICE") == "1"
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    """Fail-point counters, armed fault sites, and breaker state are
+    process-global by design (subprocess nodes arm them from env) — reset
+    around every test so one test's chaos can't leak into the next."""
+    from tendermint_tpu.crypto.breaker import device_breaker
+    from tendermint_tpu.libs import fail
+    from tendermint_tpu.libs.faults import faults
+
+    fail.reset()
+    faults.reset()
+    device_breaker.reset()
+    yield
+    fail.reset()
+    faults.reset()
+    device_breaker.reset()
 
 
 def pytest_collection_modifyitems(config, items):

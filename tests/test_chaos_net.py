@@ -1,0 +1,228 @@
+"""Partition-tolerant chaos suite over the in-proc transport: LinkPolicy
+determinism units, then the acceptance scenario — a 4-validator net keeps
+committing under seeded 10% message loss, survives a partition (minority
+stalls, majority continues), and converges with byte-identical block
+hashes after the heal. A wider seed × loss matrix runs under -m slow.
+"""
+
+import asyncio
+import collections
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tendermint_tpu.libs.faults import faults
+from tendermint_tpu.p2p import InProcNetwork
+from tendermint_tpu.p2p.inproc import LinkPolicy
+
+from test_consensus_net import make_net, wait_all_height
+
+
+# -- LinkPolicy units --------------------------------------------------------
+
+def test_link_policy_replays_exactly_per_seed():
+    plans = [LinkPolicy("a", "b", seed=7, drop_p=0.1, dup_p=0.05,
+                        reorder_p=0.1).plan() for _ in range(1)]
+    p1 = LinkPolicy("a", "b", seed=7, drop_p=0.1, dup_p=0.05, reorder_p=0.1)
+    p2 = LinkPolicy("a", "b", seed=7, drop_p=0.1, dup_p=0.05, reorder_p=0.1)
+    assert [p1.plan() for _ in range(500)] == [p2.plan() for _ in range(500)]
+    # the directed link is part of the stream key: a→b ≠ b→a, seed matters
+    p3 = LinkPolicy("b", "a", seed=7, drop_p=0.1)
+    p4 = LinkPolicy("a", "b", seed=8, drop_p=0.1)
+    base = [LinkPolicy("a", "b", seed=7, drop_p=0.1).plan()
+            for _ in range(500)]
+    assert base != [p3.plan() for _ in range(500)]
+    assert base != [p4.plan() for _ in range(500)]
+
+
+def test_link_policy_fates():
+    pol = LinkPolicy("a", "b", seed=1, drop_p=0.1, dup_p=0.1, reorder_p=0.1)
+    for _ in range(1000):
+        pol.plan()
+    # seeded, so exact-ish rates; wide bounds guard the wiring, not the RNG
+    assert 50 < pol.stats["dropped"] < 200
+    assert 50 < pol.stats["duplicated"] < 200
+    assert 50 < pol.stats["reordered"] < 250
+    assert pol.stats["delivered"] > 700
+
+    blocked = LinkPolicy("a", "b", blocked=True)
+    assert blocked.plan() is None and blocked.stats["blackholed"] == 1
+    dup = LinkPolicy("a", "b", seed=2, dup_p=1.0)
+    assert len(dup.plan()) == 2  # every message twice
+    delayed = LinkPolicy("a", "b", seed=3, delay_s=0.5)
+    assert delayed.plan() == [0.5]
+
+
+def test_net_drop_fault_site_blackholes_sends():
+    """The env-armed net.drop site rides the same try_send seam as the
+    policies — a drop reports success (a lossy wire gives no feedback)."""
+    from tendermint_tpu.p2p.inproc import InProcPeer
+
+    async def run():
+        a, b = InProcPeer("a", True), InProcPeer("b", False)
+        a._remote, b._remote = b, a
+        faults.configure("net.drop@0.5", seed=4)
+        for i in range(100):
+            assert a.try_send(1, b"m%d" % i)
+        return b._recv_queue.qsize()
+
+    got = asyncio.run(run())
+    assert 20 < got < 80, got  # ~50% dropped, deterministic per seed
+    assert faults.fires("net.drop") == 100 - got
+
+
+# -- self-healing gossip (PeerState stall refresh) ---------------------------
+
+def _peer_state_with_bitmaps():
+    from tendermint_tpu.consensus.reactor import PeerState
+    from tendermint_tpu.libs.bits import BitArray
+
+    class _P:
+        id = "peer0"
+
+    ps = PeerState(_P())
+    prs = ps.prs
+    prs.height, prs.round = 7, 2
+    prs.proposal = True
+    prs.proposal_block_parts = BitArray(8)
+    prs.proposal_block_parts.set_index(3, True)
+    prs.prevotes = BitArray(4)
+    prs.prevotes.set_index(1, True)
+    prs.precommits = BitArray(4)
+    prs.precommits.set_index(2, True)
+    return ps
+
+
+def test_refresh_if_stalled_clears_delivery_bitmaps_keeps_hrs():
+    """Gossip marks delivered-on-send; a silent peer's bitmaps are guesses
+    that can wedge the link (the post-heal failure mode this PR fixes).
+    After the stall window, the bitmaps clear; height/round — which came
+    FROM the peer — survive."""
+    ps = _peer_state_with_bitmaps()
+    ps.last_recv_t -= 10.0  # silent for 10s
+    assert ps.refresh_if_stalled(5.0)
+    prs = ps.prs
+    assert (prs.height, prs.round) == (7, 2)
+    assert prs.proposal is False
+    assert prs.proposal_block_parts.size() == 8
+    assert prs.proposal_block_parts.pick_random()[1] is False  # all clear
+    assert prs.prevotes.pick_random()[1] is False
+    assert prs.precommits.pick_random()[1] is False
+    # one refresh per silent interval: an immediate re-check is a no-op
+    prs.prevotes.set_index(0, True)
+    assert not ps.refresh_if_stalled(5.0)
+    assert prs.prevotes.pick_random()[1] is True
+
+
+def test_refresh_disabled_or_live_peer_is_noop():
+    ps = _peer_state_with_bitmaps()
+    ps.last_recv_t -= 10.0
+    assert not ps.refresh_if_stalled(0)       # 0 disables
+    assert ps.prs.proposal is True
+    ps.note_recv()                             # the peer just spoke
+    assert not ps.refresh_if_stalled(5.0)
+    assert ps.prs.proposal is True
+
+
+# -- the acceptance scenario -------------------------------------------------
+
+def _common_hash_heights(nodes, height):
+    hashes = {nd.block_store.load_block_meta(height).header.hash()
+              for nd in nodes}
+    return hashes
+
+
+def test_chaos_liveness_loss_partition_heal():
+    """4-node net: ≥5 further heights under seeded 10% drop, then one
+    partition/heal cycle, ending with byte-identical hashes everywhere."""
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            # healthy warm-up
+            await wait_all_height(nodes, 2, timeout=60)
+            # seeded 10% loss on every directed link: liveness must hold
+            h0 = min(nd.cs.state.last_block_height for nd in nodes)
+            net.set_loss(0.10, seed=42)
+            await wait_all_height(nodes, h0 + 5, timeout=120)
+            assert net.chaos_stats()["dropped"] > 0, \
+                "loss policies never dropped anything — chaos not wired"
+
+            # partition one validator off: 3/4 power keeps committing,
+            # the minority must NOT advance past what it already has
+            lone = nodes[0].switch.node_id
+            net.partition([lone])
+            h_cut = nodes[0].cs.state.last_block_height
+            h_major = min(nd.cs.state.last_block_height for nd in nodes[1:])
+            await wait_all_height(nodes[1:], h_major + 2, timeout=120)
+            # the blackhole is total: give the minority a beat, then check
+            await asyncio.sleep(0.5)
+            assert nodes[0].cs.state.last_block_height <= h_cut + 1, \
+                "partitioned node advanced through a blackholed cut"
+
+            # heal: the lone node catches up; everyone converges
+            net.heal()
+            target = max(nd.cs.state.last_block_height for nd in nodes) + 2
+            await wait_all_height(nodes, target, timeout=120)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        # byte-identical block hashes (covers app hashes) at a height all
+        # nodes share — committed across loss, partition, and heal
+        common = min(nd.cs.state.last_block_height for nd in nodes) - 1
+        assert common >= 5
+        assert len(_common_hash_heights(nodes, common)) == 1
+        assert len(_common_hash_heights(nodes, 2)) == 1
+
+    asyncio.run(run())
+
+
+def test_chaos_matrix_tool_self_test():
+    """tools/chaos_matrix.py --self-test exercises the table plumbing plus
+    the wal.fsync and db.write_batch cells in-process (CI guard; the full
+    sites × seeds sweep is the tool's default invocation)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_matrix.py"),
+         "--self-test"],
+        capture_output=True, text=True, timeout=180, cwd=repo, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "self-test OK" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,drop_p", [(1, 0.1), (2, 0.2), (3, 0.1)])
+def test_chaos_matrix_seeded_loss(seed, drop_p):
+    """Wider seed × loss sweep (the long arm of tools/chaos_matrix.py):
+    every seeded schedule must keep the net live and consistent."""
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2, timeout=60)
+            net.set_loss(drop_p, seed=seed, dup_p=0.05, reorder_p=0.05)
+            h0 = min(nd.cs.state.last_block_height for nd in nodes)
+            await wait_all_height(nodes, h0 + 4, timeout=180)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        stats = net.chaos_stats()
+        assert stats["dropped"] > 0 and stats["delivered"] > 0
+        common = min(nd.cs.state.last_block_height for nd in nodes) - 1
+        assert len(_common_hash_heights(nodes, common)) == 1
+
+    asyncio.run(run())

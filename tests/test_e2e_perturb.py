@@ -14,21 +14,30 @@ import urllib.request
 
 import pytest
 
+pytest.importorskip(
+    "tomllib",
+    reason="config TOML loading needs Python 3.11+ stdlib tomllib")
+pytest.importorskip(
+    "cryptography",
+    reason="the multi-process net's TCP transport needs the optional "
+           "'cryptography' package (absent in slim containers)")
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASE_PORT = 28800
 
 
-def _rpc(i, path):
-    url = f"http://127.0.0.1:{BASE_PORT + 2 * i + 1}/{path}"
+def _rpc(i, path, base_port=BASE_PORT):
+    url = f"http://127.0.0.1:{base_port + 2 * i + 1}/{path}"
     with urllib.request.urlopen(url, timeout=5) as r:
         return json.load(r)["result"]
 
 
-def _heights(n):
+def _heights(n, base_port=BASE_PORT):
     out = []
     for i in range(n):
         try:
-            out.append(int(_rpc(i, "status")["sync_info"]["latest_block_height"]))
+            out.append(int(_rpc(i, "status", base_port)["sync_info"]
+                           ["latest_block_height"]))
         except Exception:
             out.append(-1)
     return out
@@ -43,9 +52,7 @@ def _spawn(env, out, i):
         stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
 
 
-@pytest.mark.slow
-def test_kill_and_restart_validator(tmp_path):
-    out = str(tmp_path / "tnet")
+def _testnet_env(out, base_port):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -53,8 +60,15 @@ def test_kill_and_restart_validator(tmp_path):
     subprocess.run(
         [sys.executable, "-m", "tendermint_tpu.cmd", "testnet", "--v", "4",
          "--output-dir", out, "--chain-id", "perturb-e2e",
-         "--starting-port", str(BASE_PORT)],
+         "--starting-port", str(base_port)],
         check=True, env=env, cwd=REPO, capture_output=True, timeout=120)
+    return env
+
+
+@pytest.mark.slow
+def test_kill_and_restart_validator(tmp_path):
+    out = str(tmp_path / "tnet")
+    env = _testnet_env(out, BASE_PORT)
 
     procs = {i: _spawn(env, out, i) for i in range(4)}
     try:
@@ -101,6 +115,69 @@ def test_kill_and_restart_validator(tmp_path):
     finally:
         for p in procs.values():
             if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_pause_and_resume_validator(tmp_path):
+    """perturb.go "pause": SIGSTOP one validator — the net keeps committing
+    on 3/4 power, and after SIGCONT the frozen node (whose peers never saw
+    it exit) rejoins and catches up; app hashes agree everywhere."""
+    base_port = BASE_PORT + 100  # keep clear of the kill test's TIME_WAIT
+    out = str(tmp_path / "tnet")
+    env = _testnet_env(out, base_port)
+
+    procs = {i: _spawn(env, out, i) for i in range(4)}
+    try:
+        # phase 1: all four make progress
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if min(_heights(4, base_port)) >= 2:
+                break
+            time.sleep(1)
+        assert min(_heights(4, base_port)) >= 2, \
+            f"no initial progress: {_heights(4, base_port)}"
+
+        # perturbation: freeze node 3 mid-flight (no exit, no FIN — its
+        # sockets stay open, the hard case for peer bookkeeping)
+        procs[3].send_signal(signal.SIGSTOP)
+        h_at_pause = max(_heights(3, base_port))
+
+        # liveness on 3/4 voting power while one validator is frozen
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if min(_heights(3, base_port)) >= h_at_pause + 3:
+                break
+            time.sleep(1)
+        assert min(_heights(3, base_port)) >= h_at_pause + 3, \
+            f"net stalled while paused: {_heights(3, base_port)}"
+
+        # resume: the thawed node rejoins without a restart and catches up
+        procs[3].send_signal(signal.SIGCONT)
+        target = max(_heights(3, base_port)) + 2
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if _heights(4, base_port)[3] >= target:
+                break
+            time.sleep(1)
+        assert _heights(4, base_port)[3] >= target, \
+            f"resumed node did not catch up: {_heights(4, base_port)}"
+        assert procs[3].poll() is None, "paused node died instead of rejoining"
+
+        # invariant: app-hash agreement at a common height
+        common = min(_heights(4, base_port)) - 1
+        hashes = {_rpc(i, f"commit?height={common}", base_port)
+                  ["signed_header"]["header"]["app_hash"] for i in range(4)}
+        assert len(hashes) == 1, hashes
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGCONT)  # can't terminate a stopped proc
                 p.terminate()
         for p in procs.values():
             try:

@@ -1,0 +1,217 @@
+"""The deterministic fault-injection plane (libs/faults.py) and the
+fail-point kill switch (libs/fail.py): grammar, per-site seeded streams
+(a chaos run must replay EXACTLY from its spec+seed), trigger modifiers,
+metric accounting, and the named/threaded fail-point forms.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tendermint_tpu.libs import fail
+from tendermint_tpu.libs.faults import (
+    ENV_SEED,
+    ENV_SPEC,
+    FaultPlane,
+    InjectedFault,
+    faults,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- grammar -----------------------------------------------------------------
+
+def test_spec_grammar_modifiers():
+    fp = FaultPlane().configure("a,b@0.5,c*3,d+2,e@0.25*4+1")
+    counts = fp.counts()
+    assert set(counts) == {"a", "b", "c", "d", "e"}
+    # bare site: fires every evaluation
+    assert all(fp.fire("a") for _ in range(10))
+    # count-limited: exactly 3 fires then quiet
+    fires = sum(fp.fire("c") for _ in range(10))
+    assert fires == 3 and fp.fires("c") == 3
+    # skip: first 2 evaluations never fire
+    assert [fp.fire("d") for _ in range(4)] == [False, False, True, True]
+
+
+def test_spec_grammar_rejects_garbage():
+    for bad in ("a@1.5", "a@-0.1", "a*-1", "a+-1", "@0.5", "a@x", "a*x"):
+        with pytest.raises(ValueError):
+            FaultPlane().configure(bad)
+
+
+def test_unknown_site_never_fires():
+    fp = FaultPlane().configure("a")
+    assert not fp.fire("b")
+    assert fp.fires("b") == 0
+
+
+def test_disabled_plane_is_inert():
+    fp = FaultPlane()
+    assert not fp.enabled
+    assert not fp.fire("anything")
+    fp.inject("anything")  # no-op, must not raise
+
+
+# -- determinism -------------------------------------------------------------
+
+def test_probabilistic_site_replays_exactly():
+    seq1 = [FaultPlane().configure("s@0.3", seed=7).fire("s")
+            for _ in range(1)]
+    fp1 = FaultPlane().configure("s@0.3", seed=7)
+    fp2 = FaultPlane().configure("s@0.3", seed=7)
+    seq1 = [fp1.fire("s") for _ in range(200)]
+    seq2 = [fp2.fire("s") for _ in range(200)]
+    assert seq1 == seq2
+    assert 20 < sum(seq1) < 100  # actually probabilistic, not degenerate
+    # a different seed yields a different schedule
+    fp3 = FaultPlane().configure("s@0.3", seed=8)
+    assert seq1 != [fp3.fire("s") for _ in range(200)]
+
+
+def test_sites_draw_independent_streams():
+    """Interleaving evaluations of OTHER sites must not perturb a site's
+    own schedule — per-site RNGs are the whole point."""
+    fp1 = FaultPlane().configure("x@0.4,y@0.4", seed=3)
+    solo = FaultPlane().configure("x@0.4", seed=3)
+    interleaved = []
+    for _ in range(100):
+        interleaved.append(fp1.fire("x"))
+        fp1.fire("y")
+    assert interleaved == [solo.fire("x") for _ in range(100)]
+
+
+# -- injection ---------------------------------------------------------------
+
+def test_inject_raises_default_and_custom():
+    fp = FaultPlane().configure("site*1")
+    with pytest.raises(InjectedFault) as ei:
+        fp.inject("site")
+    assert ei.value.site == "site"
+    fp.configure("site*1")
+    with pytest.raises(OSError):
+        fp.inject("site", lambda s: OSError(5, f"injected at {s}"))
+    # count exhausted: quiet again
+    fp.inject("site")
+
+
+def test_env_configuration():
+    fp = FaultPlane().configure_from_env(
+        {ENV_SPEC: "a@0.5,b*2", ENV_SEED: "11"})
+    assert fp.enabled and fp.seed == 11 and set(fp.counts()) == {"a", "b"}
+    # empty env leaves the plane untouched
+    fp2 = FaultPlane().configure_from_env({})
+    assert not fp2.enabled
+
+
+def test_reset_disarms():
+    fp = FaultPlane().configure("a")
+    assert fp.fire("a")
+    fp.reset()
+    assert not fp.enabled and not fp.fire("a") and fp.spec == ""
+
+
+def test_singleton_metrics_accounting():
+    from tendermint_tpu.libs import faults as faults_mod
+    from tendermint_tpu.libs.metrics import FaultMetrics, Registry
+
+    fm = FaultMetrics(Registry())
+    faults_mod.set_fault_metrics(fm)
+    try:
+        faults.configure("m.site*2")
+        assert faults.fire("m.site") and faults.fire("m.site")
+        assert not faults.fire("m.site")
+        assert fm.faults_injected_total.value("m.site") == 2.0
+    finally:
+        faults_mod.set_fault_metrics(None)
+        faults.reset()
+
+
+def test_fire_is_thread_safe_under_count_limit():
+    """N threads hammering a *K site must fire exactly K times total."""
+    fp = FaultPlane().configure("t*50")
+    hits = []
+
+    def worker():
+        for _ in range(100):
+            if fp.fire("t"):
+                hits.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 50
+
+
+# -- fail.py: the kill switch ------------------------------------------------
+
+def test_fail_point_counter_thread_safe(monkeypatch):
+    """Concurrent fail points must each get a distinct index — a racy
+    double-increment would make the crash matrix skip boundaries."""
+    monkeypatch.delenv("TMTPU_FAIL_INDEX", raising=False)
+    monkeypatch.setenv("TMTPU_FAIL_INDEX", "100000")  # armed, unreachable
+    fail.reset()
+    threads = [threading.Thread(
+        target=lambda: [fail.fail_point() for _ in range(500)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fail.counter() == 4000
+
+
+def test_fail_point_named_kills_subprocess():
+    """TMTPU_FAIL_POINT=<site> dies at that named point, regardless of how
+    many anonymous points were passed on the way."""
+    code = (
+        "from tendermint_tpu.libs.fail import fail_point\n"
+        "fail_point()\n"
+        "fail_point('other.site')\n"
+        "fail_point('target.site')\n"
+        "print('SURVIVED')\n"
+    )
+    env = dict(os.environ, TMTPU_FAIL_POINT="target.site",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("TMTPU_FAIL_INDEX", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stderr
+    assert "target.site" in r.stderr and "SURVIVED" not in r.stdout
+    # without the env the same script survives all three points
+    env.pop("TMTPU_FAIL_POINT")
+    r2 = subprocess.run([sys.executable, "-c", code], env=env,
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0 and "SURVIVED" in r2.stdout
+
+
+def test_manifest_validates_fault_spec():
+    pytest.importorskip(
+        "tomllib", reason="manifest TOML loading needs Python 3.11+ tomllib")
+    from tendermint_tpu.e2e.manifest import NodeManifest
+
+    nm = NodeManifest(name="v0", faults="wal.fsync*1+3", faults_seed=9)
+    nm.validate()
+    bad = NodeManifest(name="v1", faults="wal.fsync@9")
+    with pytest.raises(ValueError, match="bad faults spec"):
+        bad.validate()
+    # a typo'd site name arms nothing and the chaos run passes vacuously —
+    # the manifest is the operator seam, so it rejects unknown sites hard
+    typo = NodeManifest(name="v2", faults="wal.fsycn*1")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        typo.validate()
+
+
+def test_armed_is_lock_free_membership():
+    fp = FaultPlane().configure("wal.fsync@0.0")
+    assert fp.armed("wal.fsync")          # armed even at prob 0
+    assert not fp.armed("db.write_batch")
+    assert not fp.armed("wal.fsync") or fp.fire("wal.fsync") is False
+    fp.reset()
+    assert not fp.armed("wal.fsync")

@@ -1,0 +1,201 @@
+"""fsyncgate semantics under injected storage faults: a failed WAL fsync
+is fatal (exit by default, FsyncError for in-process harnesses) because a
+record whose fsync failed must NEVER be treated as durable; a failed DB
+write-batch applies nothing and keeps the staged window intact. The crash
+matrix re-runs the consensus machine with an injected fsync failure at
+EVERY sync boundary and proves restart always replays to a consistent
+height — no record handled-but-not-durable.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tendermint_tpu.consensus.replay import catchup_replay
+from tendermint_tpu.consensus.wal import FSYNC_EXIT_CODE, WAL, FsyncError
+from tendermint_tpu.libs.db import BufferedDB, MemDB, SQLiteDB
+from tendermint_tpu.libs.faults import faults
+from tendermint_tpu.libs.metrics import ConsensusMetrics, Registry
+from tendermint_tpu.privval.file_pv import FilePV
+
+from test_crash_recovery import TARGET_HEIGHT, _boot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def raise_policy(monkeypatch):
+    """In-process harnesses can't take os._exit; surface FsyncError."""
+    monkeypatch.setattr(WAL, "fsync_error_policy", "raise")
+
+
+# -- WAL ---------------------------------------------------------------------
+
+def test_wal_fsync_fault_raises_and_counts(tmp_path, raise_policy):
+    wal = WAL(str(tmp_path / "cs.wal"))
+    m = ConsensusMetrics(Registry())
+    wal.metrics = m
+    faults.configure("wal.fsync*1")
+    with pytest.raises(FsyncError) as ei:
+        wal.write_sync("round_step", {"height": 1})
+    # BaseException on purpose: a defensive `except Exception` anywhere in
+    # the consensus loop must NOT be able to swallow it and carry on
+    assert not isinstance(ei.value, Exception)
+    assert m.wal_fsync_errors_total.value() == 1.0
+    # the site is exhausted: the WAL keeps working after a restart-style
+    # reopen (the failed record's bytes were appended+flushed, so replay
+    # decides its fate from the file, not from in-memory state)
+    wal.close()
+    wal2 = WAL(str(tmp_path / "cs.wal"))
+    wal2.write_sync("round_step", {"height": 2})
+    wal2.close()
+
+
+def test_wal_fsync_fault_exits_process_by_default(tmp_path):
+    """Default policy: the process dies with the sysexits EX_IOERR code —
+    the subprocess-node analog of the reference's panic, and what the e2e
+    runner's fault manifests produce."""
+    code = (
+        "from tendermint_tpu.consensus.wal import WAL\n"
+        f"wal = WAL({str(tmp_path / 'sub.wal')!r})\n"
+        "print('UNREACHABLE')\n"
+    )
+    env = dict(os.environ, TMTPU_FAULTS="wal.fsync*1",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == FSYNC_EXIT_CODE, (r.returncode, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+
+
+def test_group_commit_fsync_fault_keeps_batch_replayable(tmp_path,
+                                                         raise_policy):
+    """A group whose commit-fsync fails: every record of the batch was
+    appended and flushed BEFORE the fsync, so a restart replays the whole
+    batch from the file — the crash loses durability, never framing."""
+    path = str(tmp_path / "grp.wal")
+    wal = WAL(path)
+    # armed after the constructor's sync, so the group-exit fsync is the
+    # site's first evaluation
+    faults.configure("wal.fsync*1")
+    with pytest.raises(FsyncError):
+        with wal.group():
+            for h in (1, 2, 3):
+                wal.write_sync("round_step", {"height": h})
+    wal.close()
+    replayed = [m.data["height"] for m in WAL(path).iter_messages()
+                if m.type == "round_step"]
+    assert replayed == [1, 2, 3]
+    # a torn tail on top: truncate into the last record — replay stops
+    # cleanly at the previous boundary instead of erroring
+    faults.reset()
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[:-3])
+    torn = [m.data["height"] for m in WAL(path).iter_messages()
+            if m.type == "round_step"]
+    assert torn == [1, 2]
+
+
+def test_crash_at_every_fsync_boundary(tmp_path, raise_policy):
+    """The acceptance matrix: inject an fsync failure at the K-th sync
+    boundary (group commits included) for every K until the chain outruns
+    the crash point; restart from the same storage each time. Heights
+    never regress and the chain reaches the target — proving no record
+    was ever handled on the strength of a failed fsync."""
+    FilePV.generate(str(tmp_path / "pv_key.json"),
+                    str(tmp_path / "pv_state.json")).save()
+
+    async def run():
+        wal_path = str(tmp_path / "cs.wal")
+        boundary = 0
+        last_height = 0
+        crashes = 0
+        while True:
+            faults.configure(f"wal.fsync*1+{boundary}")
+            try:
+                wal = WAL(wal_path)
+            except FsyncError:
+                # boundary 0 is the fresh WAL's own end_height-0 sync
+                crashes += 1
+                boundary += 1
+                continue
+            cs = _boot(tmp_path, wal)
+            catchup_replay(cs, cs.rs.height)
+            crash = {}
+            orig = cs.receive_routine
+
+            async def guarded():
+                try:
+                    await orig()
+                except FsyncError as e:
+                    crash["err"] = e
+
+            cs.receive_routine = guarded
+            await cs.start()
+            try:
+                for _ in range(600):
+                    if crash:
+                        status = "crashed"
+                        break
+                    if cs.state.last_block_height >= TARGET_HEIGHT:
+                        status = "done"
+                        break
+                    await asyncio.sleep(0.02)
+                else:
+                    raise AssertionError(
+                        f"no progress and no crash at boundary {boundary} "
+                        f"(h={cs.state.last_block_height})")
+            finally:
+                faults.reset()  # stop() fsyncs; the armed site is spent anyway
+                await cs.stop()
+            height = cs.state.last_block_height
+            assert height >= last_height, (
+                f"height regressed after fsync crash {boundary}: "
+                f"{height} < {last_height}")
+            last_height = height
+            if status == "done":
+                break
+            crashes += 1
+            boundary += 1
+            assert boundary < 400, "fsync crash matrix did not converge"
+        assert crashes >= 3, f"only {crashes} fsync boundaries before target"
+        assert last_height >= TARGET_HEIGHT
+
+    asyncio.run(run())
+
+
+# -- DB write batches --------------------------------------------------------
+
+def test_buffered_flush_fault_preserves_staged_window(tmp_path):
+    base = MemDB()
+    buf = BufferedDB(base)
+    buf.set(b"k1", b"v1")
+    buf.set(b"k2", b"v2")
+    buf.delete(b"gone")
+    assert buf.pending() == 3
+    faults.configure("db.write_batch*1")
+    with pytest.raises(OSError):
+        buf.flush()
+    # handled-but-not-durable guard: nothing applied, nothing dropped
+    assert base.get(b"k1") is None
+    assert buf.pending() == 3
+    assert buf.get(b"k1") == b"v1"  # read-through still serves the window
+    # site exhausted: the retry commits the SAME window
+    buf.flush()
+    assert base.get(b"k1") == b"v1" and base.get(b"k2") == b"v2"
+    assert buf.pending() == 0
+
+
+def test_sqlite_write_batch_fault_is_all_or_nothing(tmp_path):
+    db = SQLiteDB(str(tmp_path / "kv.db"))
+    faults.configure("db.write_batch*1")
+    with pytest.raises(OSError):
+        db.write_batch([(b"a", b"1"), (b"b", b"2")])
+    assert db.get(b"a") is None and db.get(b"b") is None
+    db.write_batch([(b"a", b"1"), (b"b", b"2")])
+    assert db.get(b"a") == b"1" and db.get(b"b") == b"2"

@@ -1,0 +1,103 @@
+"""Consensus stall watchdog (consensus/watchdog.py): injected fault →
+observable degradation. A stuck height must raise consensus_stalled_total
+exactly once per episode, leave a debugdump bundle behind, and re-arm only
+after the height moves again.
+"""
+
+import asyncio
+import os
+import types
+
+from tendermint_tpu.consensus.watchdog import ConsensusWatchdog
+from tendermint_tpu.libs.metrics import ConsensusMetrics, Registry
+
+
+class _FakeCS:
+    """The two attributes the watchdog reads: state.last_block_height and
+    rs (for the round/step in the CRITICAL log line)."""
+
+    def __init__(self, height=1):
+        self.state = types.SimpleNamespace(last_block_height=height)
+        self.rs = types.SimpleNamespace(height=height, round=0, step="propose")
+
+
+def test_stall_fires_once_per_episode_and_rearms(tmp_path):
+    async def run():
+        cs = _FakeCS()
+        m = ConsensusMetrics(Registry())
+        wd = ConsensusWatchdog(cs, stall_timeout_s=0.2, metrics=m,
+                               dump_dir=str(tmp_path), dump_node=None,
+                               check_interval_s=0.05)
+        await wd.start()
+        # height frozen: one episode, and only one, however long it lasts
+        await asyncio.sleep(0.6)
+        assert wd.stalls == 1
+        assert m.consensus_stalled_total.value() == 1
+        assert wd.last_dump_path is not None
+        assert os.path.exists(wd.last_dump_path)
+
+        # progress clears the episode but does NOT count a new one
+        cs.state.last_block_height = 2
+        await asyncio.sleep(0.15)
+        assert wd.stalls == 1
+
+        # a second freeze is a second episode
+        await asyncio.sleep(0.5)
+        assert wd.stalls == 2
+        assert m.consensus_stalled_total.value() == 2
+        await wd.stop()
+
+    asyncio.run(run())
+
+
+def test_no_stall_while_height_advances(tmp_path):
+    async def run():
+        cs = _FakeCS()
+        wd = ConsensusWatchdog(cs, stall_timeout_s=0.3,
+                               dump_dir=str(tmp_path), dump_node=None,
+                               check_interval_s=0.05)
+        await wd.start()
+        for h in range(2, 10):
+            cs.state.last_block_height = h
+            await asyncio.sleep(0.08)
+        assert wd.stalls == 0
+        assert wd.last_dump_path is None
+        await wd.stop()
+
+    asyncio.run(run())
+
+
+def test_stop_cancels_cleanly(tmp_path):
+    async def run():
+        wd = ConsensusWatchdog(_FakeCS(), stall_timeout_s=5.0,
+                               dump_dir=str(tmp_path),
+                               check_interval_s=0.05)
+        await wd.start()
+        await asyncio.sleep(0.1)
+        await wd.stop()
+        assert wd._task is None
+
+    asyncio.run(run())
+
+
+def test_dump_failure_does_not_kill_the_watchdog():
+    """debugdump failing (bad dir) must not take the watchdog loop down —
+    the metric is the alertable signal, the bundle is best-effort."""
+    async def run():
+        cs = _FakeCS()
+        m = ConsensusMetrics(Registry())
+        wd = ConsensusWatchdog(cs, stall_timeout_s=0.1, metrics=m,
+                               dump_dir="/nonexistent/definitely/not/here",
+                               check_interval_s=0.05)
+        await wd.start()
+        await asyncio.sleep(0.3)
+        assert wd.stalls == 1
+        assert m.consensus_stalled_total.value() == 1
+        # loop survived: progress + a second freeze still counts
+        cs.state.last_block_height = 2
+        await asyncio.sleep(0.1)
+        await asyncio.sleep(0.25)
+        assert wd.stalls == 2
+        await wd.stop()
+
+    asyncio.run(run())
