@@ -537,6 +537,35 @@ def _prom_sum(text: str, name: str) -> float:
     return total
 
 
+def _prom_by_label(text: str, name: str, label: str) -> dict:
+    """{label value: series value} for one labeled series (exposition
+    text), e.g. per-stage sums of the consensus stage histogram."""
+    out = {}
+    needle = f'{label}="'
+    for line in text.splitlines():
+        if not line.startswith(name + "{"):
+            continue
+        rest = line[len(name):]
+        i = rest.find(needle)
+        if i < 0:
+            continue
+        val = rest[i + len(needle):]
+        val = val[:val.index('"')]
+        try:
+            out[val] = out.get(val, 0.0) + float(line.rsplit(" ", 1)[1])
+        except ValueError:
+            pass
+    return out
+
+
+def _tools_mod(name: str):
+    """Import a stdlib-only module out of tools/ (trace_summary,
+    fleet_scrape, trace_merge) without making tools a package."""
+    from tendermint_tpu.libs.toolbox import load_tool
+
+    return load_tool(name)
+
+
 def bench_localnet():
     """Config #4: 4-node localnet over TCP (kvstore app), consensus reactor
     end-to-end. Measures blocks/min across the net and broadcast_tx_commit
@@ -562,6 +591,7 @@ def bench_localnet():
 
     procs = []
     per_height = None
+    fleet = None
     try:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         # CPU-pinned subprocesses (init included) must not touch the TPU
@@ -572,6 +602,8 @@ def bench_localnet():
         # each node runs under the span tracer and writes a Chrome trace on
         # graceful shutdown — the per-height live-plane attribution input
         env["TMTPU_TRACE_OUT"] = os.path.join(root, "trace")
+        # a watchdog debugdump during the run snapshots the fleet rollup
+        env["TMTPU_FLEET_JSON"] = os.path.join(root, "fleet.json")
         subprocess.run(
             ["python", "-m", "tendermint_tpu.cmd", "testnet", "--v", "4",
              "--output-dir", root, "--chain-id", "bench-e2e",
@@ -595,6 +627,19 @@ def bench_localnet():
                 pass
             time.sleep(1.0)
         assert h0 is not None and h0 >= 2, "localnet failed to start"
+
+        # fleet metrics aggregator (tools/fleet_scrape.py): poll all four
+        # nodes' /metrics during the measurement window so the reported
+        # numbers are cluster truth, not node-0's view
+        try:
+            fs = _tools_mod("fleet_scrape")
+            fleet = fs.FleetScraper(
+                {f"node{i}": f"http://127.0.0.1:{port0 + 8 + i}/metrics"
+                 for i in range(4)},
+                interval_s=2.0,
+                out_path=os.path.join(root, "fleet.json")).start()
+        except Exception:
+            fleet = None
 
         # measure block rate over a fixed window + tx commit latency
         t0 = time.time()
@@ -653,10 +698,52 @@ def bench_localnet():
                   wal_records_per_fsync_avg=round(
                       rec_sum / max(1.0, rec_cnt), 2),
                   wal_fsync_seconds_total=round(fsync_s, 4))
+            # per-stage consensus latency decomposition from the stage
+            # timeline histograms (consensus/timeline.py): mean seconds per
+            # stage interval at this node — the bench row the ROADMAP scale
+            # items will attribute regressions through
+            s_sum = _prom_by_label(mtext, pre + "stage_seconds_sum", "stage")
+            s_cnt = _prom_by_label(mtext, pre + "stage_seconds_count",
+                                   "stage")
+            stage_mean_ms = {
+                s: round(s_sum[s] / s_cnt[s] * 1000.0, 3)
+                for s in sorted(s_sum) if s_cnt.get(s)}
+            if stage_mean_ms:
+                _emit("localnet_4node_stage_breakdown",
+                      sum(stage_mean_ms.values()) / 1000.0, "s", 0.0,
+                      stage_mean_ms=stage_mean_ms,
+                      heights_observed=int(max(s_cnt.values())))
         except Exception as e:
             _emit("localnet_4node_live_plane_breakdown", 0.0, "error", 0.0,
                   error=f"{type(e).__name__}: {e}")
+
+        # cluster rollup: blocks/min as the CLUSTER saw it (max committed
+        # height across nodes), gossip wakeups per peer link, and the
+        # cross-node spread of committed heights at the last scrape
+        if fleet is not None:
+            try:
+                roll = fleet.stop()
+                fleet = None
+                hs = roll["series"].get(
+                    "tendermint_consensus_committed_height", {})
+                _emit("localnet_4node_cluster_rollup",
+                      roll.get("cluster_blocks_per_min", 0.0), "blocks/min",
+                      roll.get("cluster_blocks_per_min", 0.0) / 19.5,
+                      n_nodes=roll["n_nodes"],
+                      scrapes=roll["scrapes"],
+                      scrape_errors=roll["scrape_errors"],
+                      height_min=hs.get("min"), height_max=hs.get("max"),
+                      wakeups_per_peer_link=roll.get(
+                          "wakeups_per_peer_link", 0.0))
+            except Exception as e:
+                _emit("localnet_4node_cluster_rollup", 0.0, "error", 0.0,
+                      error=f"{type(e).__name__}: {e}")
     finally:
+        if fleet is not None:  # a failed run must not leak the scraper
+            try:
+                fleet.stop()
+            except Exception:
+                pass
         for p in procs:
             try:
                 p.send_signal(signal.SIGTERM)
@@ -668,19 +755,21 @@ def bench_localnet():
             except Exception:
                 p.kill()
         # per-height live-plane attribution from the nodes' shutdown traces
-        # (gossip wait vs WAL sync vs apply per height) — best-effort
+        # (gossip wait vs WAL sync vs apply vs consensus stage_* spans per
+        # height) — best-effort
+        skew = None
+        trace_paths = []
         try:
-            sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
-            try:
-                from trace_summary import by_height, load_events
-            finally:
-                sys.path.pop(0)
+            trace_summary = _tools_mod("trace_summary")
+            by_height = trace_summary.by_height
+            load_events = trace_summary.load_events
             merged = {}
-            for name in sorted(os.listdir(root)):
-                if not (name.startswith("trace-") and name.endswith(".json")):
-                    continue
-                for h, per in by_height(
-                        load_events(os.path.join(root, name))).items():
+            trace_paths = [os.path.join(root, name)
+                           for name in sorted(os.listdir(root))
+                           if name.startswith("trace-")
+                           and name.endswith(".json")]
+            for path in trace_paths:
+                for h, per in by_height(load_events(path)).items():
                     tgt = merged.setdefault(h, {})
                     for span, us in per.items():
                         tgt[span] = tgt.get(span, 0.0) + us
@@ -693,11 +782,36 @@ def bench_localnet():
                 per_height = {"n_heights": n_h, "mean_ms_per_height": mean_ms}
         except Exception:
             per_height = None
+        # cross-node correlation: merge the four traces onto one wall
+        # clock (tools/trace_merge.py) and report the commit skew —
+        # first-to-last commit spread per height across nodes. Own
+        # try/except: a torn trace from a SIGKILLed node must not wipe
+        # the per-height breakdown computed above.
+        try:
+            if len(trace_paths) >= 2:
+                tm = _tools_mod("trace_merge")
+                docs = []
+                for p in trace_paths:
+                    doc = tm.load_trace(p)
+                    docs.append((tm.node_label(doc, p), doc))
+                report = tm.skew_report(docs)
+                if report["heights"]:
+                    skew = {"heights": report["heights"],
+                            "mean_spread_ms": report["mean_spread_ms"],
+                            "max_spread_ms": report["max_spread_ms"],
+                            "slowest_stage_per_node": {
+                                n: s["slowest_stage"] for n, s in
+                                report["slowest_stage_per_node"].items()}}
+        except Exception:
+            skew = None
         shutil.rmtree(root, ignore_errors=True)
     if per_height is not None:
         _emit("localnet_4node_per_height_breakdown",
               per_height["mean_ms_per_height"].get("gossip_idle", 0.0),
               "ms/height", 0.0, **per_height)
+    if skew is not None:
+        _emit("localnet_4node_commit_skew", skew["mean_spread_ms"],
+              "ms/height", 0.0, **skew)
 
 
 def bench_verify_commit_10k():

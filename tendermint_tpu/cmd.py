@@ -102,9 +102,14 @@ def cmd_start(args) -> int:
     # localnet's per-height live-plane breakdown (gossip wait / WAL sync /
     # apply) is recoverable with tools/trace_summary.py --by-height
     trace_prefix = os.environ.get("TMTPU_TRACE_OUT")
-    if trace_prefix:
-        from .libs.trace import tracer as _tracer
+    from .libs.trace import tracer as _tracer
 
+    # stamp the trace with this node's identity + wall↔perf epoch so
+    # tools/trace_merge.py can align N nodes' traces onto one timeline
+    # (TMTPU_NODE_ID overrides for runners that name nodes themselves)
+    _tracer.set_identity(os.environ.get("TMTPU_NODE_ID")
+                         or cfg.base.moniker or f"pid-{os.getpid()}")
+    if trace_prefix:
         _tracer.enable()
 
     async def run():

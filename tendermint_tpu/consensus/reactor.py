@@ -569,6 +569,9 @@ class ConsensusReactor(Reactor):
                 return
             if isinstance(msg, ProposalMessageWire):
                 ps.set_has_proposal(msg.proposal)
+                # stage-timeline aux mark at WIRE receipt: the gap to the
+                # state machine's proposal_received mark is queue delay
+                self.cs.timeline.note_wire_proposal(msg.proposal.height)
                 await self.cs.add_peer_msg(ProposalMessage(msg.proposal), peer.id)
             elif isinstance(msg, ProposalPOLMessage):
                 ps.apply_proposal_pol(msg)

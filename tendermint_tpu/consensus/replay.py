@@ -37,6 +37,9 @@ logger = logging.getLogger("tmtpu.replay")
 def catchup_replay(cs: ConsensusState, height: int) -> None:
     """Replay WAL messages for `height` into the paused state machine."""
     cs._replay_mode = True
+    # replayed marks would be microseconds apart at replay time — not a
+    # consensus-stage decomposition; the first live mark reopens the record
+    cs.timeline.enabled = False
     try:
         if cs.wal.search_for_end_height(height):
             raise RuntimeError(
@@ -47,6 +50,7 @@ def catchup_replay(cs: ConsensusState, height: int) -> None:
             _replay_message(cs, m)
     finally:
         cs._replay_mode = False
+        cs.timeline.enabled = True
 
 
 def _replay_message(cs: ConsensusState, m: WALMessage) -> None:

@@ -117,6 +117,13 @@ class ConsensusState:
         from ..crypto.vote_batcher import BatchVoteVerifier
         self.vote_verifier = BatchVoteVerifier()
         self.metrics = None  # ConsensusMetrics, wired by the node
+        # per-height stage timeline (consensus/timeline.py): wall-clock
+        # marks at each stage of every height, sealed at commit into
+        # stage_seconds histograms + height-tagged trace spans + a bounded
+        # ring served over RPC/debugdump. Always on — a mark is a couple of
+        # clock reads and dict stores per stage per height.
+        from .timeline import StageTimeline
+        self.timeline = StageTimeline()
         # byzantine test hooks (the reference's maverick node,
         # test/maverick/consensus/misbehavior.go): height -> behavior name.
         # Supported: "double-prevote" (equivocate at prevote). Only MockPV
@@ -520,6 +527,7 @@ class ConsensusState:
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
+        self.timeline.begin_height(height)
         self._new_step()
 
     def reconstruct_last_commit(self, state: State) -> None:
@@ -885,6 +893,10 @@ class ConsensusState:
         logger.info("finalizing commit of block height=%d hash=%s txs=%d",
                     height, block.hash().hex()[:12], len(block.data.txs))
 
+        # seals the height's stage timeline: observes stage_seconds and
+        # emits the per-stage trace spans (consensus/timeline.py)
+        self.timeline.mark(height, rs.commit_round, "commit_finalized")
+
         if self.metrics is not None:
             self._record_commit_metrics(block)
 
@@ -933,6 +945,8 @@ class ConsensusState:
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
+        self.timeline.mark(proposal.height, proposal.round,
+                           "proposal_received")
         logger.info("received proposal %d/%d", proposal.height, proposal.round)
         for listener in self.proposal_data_listeners:
             listener()
@@ -1043,6 +1057,9 @@ class ConsensusState:
 
         if vote.type == SignedMsgType.PREVOTE:
             prevotes = rs.votes.prevotes(vote.round)
+            if (not self.timeline.marked(height, "prevote_quorum")
+                    and prevotes.has_two_thirds_any()):
+                self.timeline.mark(height, vote.round, "prevote_quorum")
             block_id, ok = prevotes.two_thirds_majority()
             if ok:
                 # unlock on newer POL for a different block
@@ -1087,6 +1104,9 @@ class ConsensusState:
 
         elif vote.type == SignedMsgType.PRECOMMIT:
             precommits = rs.votes.precommits(vote.round)
+            if (not self.timeline.marked(height, "precommit_quorum")
+                    and precommits.has_two_thirds_any()):
+                self.timeline.mark(height, vote.round, "precommit_quorum")
             block_id, ok = precommits.two_thirds_majority()
             if ok:
                 self._enter_new_round(height, vote.round)
@@ -1150,4 +1170,7 @@ class ConsensusState:
                              self.rs.height, self.rs.round, e)
             return None
         self.send_internal(VoteMessage(vote))
+        self.timeline.mark(self.rs.height, self.rs.round,
+                           "prevote_sent" if msg_type == SignedMsgType.PREVOTE
+                           else "precommit_sent")
         return vote

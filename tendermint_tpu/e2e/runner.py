@@ -32,6 +32,22 @@ from .manifest import Manifest, NodeManifest
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _have_aiohttp() -> bool:
+    """The node's /metrics server needs aiohttp; slim containers without it
+    must still run e2e nets (just without the fleet scrape plane)."""
+    import importlib.util
+
+    return importlib.util.find_spec("aiohttp") is not None
+
+
+def _fleet_scrape_mod():
+    """Import tools/fleet_scrape.py (stdlib-only, lives outside the
+    package)."""
+    from ..libs.toolbox import load_tool
+
+    return load_tool("fleet_scrape")
+
+
 class E2EError(Exception):
     pass
 
@@ -46,6 +62,8 @@ class Runner:
         self.configs: Dict[str, Config] = {}
         self.node_ids: Dict[str, str] = {}
         self.loaded_txs: List[bytes] = []
+        self._fleet = None            # FleetScraper while the net runs
+        self.fleet_rollup: Optional[dict] = None
         self._log = open(os.path.join(root, "runner.log"), "w") \
             if os.path.isdir(root) else None
 
@@ -53,11 +71,15 @@ class Runner:
 
     def _ports(self, i: int):
         base = self.base_port + 4 * i
-        return base, base + 1, base + 2  # p2p, rpc, privval
+        return base, base + 1, base + 2  # p2p, rpc, privval (+3 = metrics)
 
     def _rpc_port(self, name: str) -> int:
         idx = [n.name for n in self.m.nodes].index(name)
         return self._ports(idx)[1]
+
+    def _metrics_port(self, name: str) -> int:
+        idx = [n.name for n in self.m.nodes].index(name)
+        return self.base_port + 4 * idx + 3
 
     # -- stages --------------------------------------------------------------
 
@@ -81,6 +103,13 @@ class Runner:
             cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p}"
             cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc}"
             cfg.mempool.version = nm.mempool_version
+            if _have_aiohttp():
+                # fleet observability: every node serves /metrics on the
+                # 4th port of its block so the runner's fleet scraper can
+                # roll up cluster-truth series during the run
+                cfg.instrumentation.prometheus = True
+                cfg.instrumentation.prometheus_listen_addr = (
+                    f"tcp://127.0.0.1:{self._metrics_port(nm.name)}")
             if nm.privval == "tcp":
                 cfg.base.priv_validator_laddr = f"tcp://127.0.0.1:{pvp}"
             if nm.state_sync:
@@ -135,6 +164,11 @@ class Runner:
         # stall watchdog: an e2e node that silently stops committing should
         # leave a debugdump bundle behind, not just a hung run
         env.setdefault("TMTPU_STALL_WATCHDOG_S", "60")
+        # cluster observability: node traces carry the manifest name, and a
+        # watchdog debugdump snapshots the runner's fleet rollup (the
+        # scraper keeps this file fresh while the net runs)
+        env["TMTPU_NODE_ID"] = nm.name
+        env["TMTPU_FLEET_JSON"] = os.path.join(self.root, "fleet.json")
         return env
 
     def _launch(self, nm: NodeManifest) -> None:
@@ -174,6 +208,10 @@ class Runner:
             if nm.state_sync:
                 self._point_state_sync(nm)
             self._launch(nm)
+            if self._fleet is not None:
+                self._fleet.add_endpoint(
+                    nm.name,
+                    f"http://127.0.0.1:{self._metrics_port(nm.name)}/metrics")
 
     def _point_state_sync(self, nm: NodeManifest) -> None:
         """Fill rpc_servers + trust root from the live net just before the
@@ -247,7 +285,34 @@ class Runner:
         target = self.max_height() + (blocks or self.m.wait_blocks)
         self.wait_for_height(target)
 
+    # -- fleet metrics (tools/fleet_scrape.py) -------------------------------
+
+    def start_fleet_scrape(self, interval_s: float = 2.0) -> None:
+        """Scrape every launched node's /metrics on an interval; the rollup
+        JSON (root/fleet.json) stays fresh for debugdump bundles and is
+        summarized into self.fleet_rollup at stop."""
+        if self._fleet is not None or not _have_aiohttp():
+            return
+        endpoints = {
+            name: f"http://127.0.0.1:{self._metrics_port(name)}/metrics"
+            for name in self.procs}
+        if not endpoints:
+            return
+        mod = _fleet_scrape_mod()
+        self._fleet = mod.FleetScraper(
+            endpoints, interval_s=interval_s,
+            out_path=os.path.join(self.root, "fleet.json")).start()
+
+    def stop_fleet_scrape(self) -> Optional[dict]:
+        if self._fleet is None:
+            return None
+        # stop()'s final sweep already refreshed out_path (root/fleet.json)
+        self.fleet_rollup = self._fleet.stop()
+        self._fleet = None
+        return self.fleet_rollup
+
     def stop(self) -> None:
+        self.stop_fleet_scrape()
         for proc in list(self.procs.values()) + list(self.signers.values()):
             try:
                 proc.send_signal(signal.SIGTERM)
@@ -399,6 +464,7 @@ class Runner:
         self.setup()
         try:
             self.start()
+            self.start_fleet_scrape()
             self.load()
             self.start_late_joiners()
             self.wait_all_alive()

@@ -34,6 +34,8 @@ _INSTALLED: dict = {}
 # how many trailing trace events land in the dump bundle (full rings are
 # 64k events — the tail is what describes the moments before the wedge)
 _TRACE_TAIL_EVENTS = 512
+# how many sealed heights of the consensus stage timeline ride along
+_TIMELINE_TAIL_HEIGHTS = 32
 # give the off-thread metrics render this long before the dump moves on
 _METRICS_RENDER_TIMEOUT_S = 2.0
 
@@ -106,7 +108,33 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
         events = tracer.tail(_TRACE_TAIL_EVENTS)
         if events:
             with open(os.path.join(out_dir, "trace_tail.json"), "w") as f:
-                json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+                json.dump(tracer.chrome_trace(events), f)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+    # per-height stage timeline tail (consensus/timeline.py): a watchdog
+    # dump should say WHICH consensus stage the stalled height wedged in —
+    # the in-flight record's marks end exactly where progress stopped
+    try:
+        import json
+
+        tl = getattr(getattr(node, "consensus_state", None), "timeline",
+                     None)
+        if tl is not None:
+            with open(os.path.join(out_dir, "stage_timeline.json"), "w") as f:
+                json.dump(tl.snapshot(_TIMELINE_TAIL_HEIGHTS), f, indent=1)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+    # fleet-rollup snapshot, when a fleet scraper is running alongside this
+    # node (e2e runner / bench config 4 export TMTPU_FLEET_JSON and keep the
+    # file fresh): the cluster's view of the moment this node stalled
+    try:
+        fleet = os.environ.get("TMTPU_FLEET_JSON")
+        if fleet and os.path.exists(fleet):
+            import shutil
+
+            shutil.copy(fleet, os.path.join(out_dir, "fleet_rollup.json"))
     except Exception:
         traceback.print_exc(file=sys.stderr)
 

@@ -339,6 +339,20 @@ class ConsensusMetrics:
             "consensus", "gossip_peer_refreshes_total",
             "Silent-peer delivery bitmaps cleared for re-gossip "
             "(gossip_stall_refresh_s).")
+        # -- observability plane (consensus/timeline.py stage timeline) --
+        # series tendermint_consensus_stage_seconds{stage=...}: per-height
+        # interval from the previous stage mark to this one, observed when
+        # the height seals at commit — the per-phase latency decomposition
+        # of the consensus round (arXiv 2302.00418 / 2410.03347 attribute
+        # wins exactly this way)
+        self.stage_seconds = h(
+            "consensus", "stage_seconds",
+            "Seconds from the previous consensus stage mark to this one "
+            "(proposal_received, prevote_sent, prevote_quorum, "
+            "precommit_sent, precommit_quorum, commit_finalized).",
+            ["stage"],
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0))
 
 
 class MempoolMetrics:
@@ -488,3 +502,9 @@ class NodeMetrics:
         self.crypto = CryptoMetrics(self.registry)
         self.blocksync = BlocksyncMetrics(self.registry)
         self.faults = FaultMetrics(self.registry)
+        # tracer ring saturation (libs/trace.py): a bounded ring that
+        # silently ate its front reads as "nothing happened early on" —
+        # this series (plus the export header's `dropped`) says otherwise
+        self.trace_dropped_events_total = self.registry.counter(
+            "trace", "dropped_events_total",
+            "Trace events pushed off the bounded ring by newer events.")

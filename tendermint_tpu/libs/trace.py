@@ -86,7 +86,7 @@ class _Span:
         }
         if self._args:
             ev["args"] = self._args
-        self._tracer._buf.append(ev)
+        self._tracer._record(ev)
 
 
 _PID = os.getpid()
@@ -100,6 +100,17 @@ class Tracer:
         self.capacity = capacity
         self.enabled = enabled
         self._buf: "collections.deque" = collections.deque(maxlen=capacity)
+        #: events pushed off the full ring (saturation visibility: a trace
+        #: whose front was eaten should SAY so, not just look short)
+        self.dropped = 0
+        #: optional Counter (NodeMetrics.trace_dropped_events_total) so the
+        #: saturation shows up on /metrics, not only in the export header
+        self.drop_counter = None
+        #: cross-node correlation identity (set_identity): who produced this
+        #: trace, and how its perf_counter timeline maps onto wall clock
+        self.node_id: Optional[str] = None
+        self.epoch_unix_s: Optional[float] = None
+        self.epoch_perf_us: Optional[float] = None
 
     # -- control -------------------------------------------------------------
 
@@ -111,8 +122,31 @@ class Tracer:
 
     def clear(self) -> None:
         self._buf.clear()
+        self.dropped = 0
+
+    def set_identity(self, node_id: str) -> None:
+        """Stamp this process's trace with a node id and a wall↔perf epoch
+        pair. ``ts`` fields stay in the process-local perf_counter domain;
+        the export header carries (epoch_unix_s, epoch_perf_us) sampled at
+        the same instant, so tools/trace_merge.py can re-base N nodes'
+        events onto the shared wall clock and align their tracks."""
+        self.node_id = str(node_id)
+        self.epoch_unix_s = time.time()
+        self.epoch_perf_us = time.perf_counter() * 1e6
 
     # -- recording -----------------------------------------------------------
+
+    def _record(self, ev: dict) -> None:
+        buf = self._buf
+        if len(buf) == buf.maxlen:
+            self.dropped += 1
+            c = self.drop_counter
+            if c is not None:
+                try:
+                    c.inc()
+                except Exception:
+                    pass
+        buf.append(ev)
 
     def span(self, name: str, **args) -> object:
         """Context manager timing its body as one complete trace event.
@@ -130,7 +164,21 @@ class Tracer:
               "tid": threading.get_ident() & 0x7FFFFFFF}
         if args:
             ev["args"] = args
-        self._buf.append(ev)
+        self._record(ev)
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 **args) -> None:
+        """Record a complete event with an EXPLICIT start/duration (both in
+        perf_counter microseconds) — for retroactive spans whose endpoints
+        were sampled outside a context manager (the consensus stage
+        timeline seals a height and emits one span per stage interval)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+              "pid": _PID, "tid": threading.get_ident() & 0x7FFFFFFF}
+        if args:
+            ev["args"] = args
+        self._record(ev)
 
     # -- export --------------------------------------------------------------
 
@@ -143,10 +191,27 @@ class Tracer:
             return list(buf)
         return list(buf)[-n:]
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, events: Optional[list] = None) -> dict:
         """The standard trace-event container Perfetto/chrome://tracing
-        load: {"traceEvents": [...], "displayTimeUnit": "ms"}."""
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        load: {"traceEvents": [...], "displayTimeUnit": "ms"} — plus the
+        correlation header (node_id + wall↔perf epoch, set_identity) and a
+        ``dropped`` count so a saturated ring is visible instead of a
+        silently truncated trace. Viewers ignore the extra keys. Pass
+        ``events`` to wrap a subset (debugdump's tail) in the same
+        header instead of the full ring."""
+        if events is None:
+            events = self.events()
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "dropped": self.dropped}
+        if self.node_id is not None:
+            # Perfetto names the pid track from this metadata event
+            doc["traceEvents"] = [{
+                "name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
+                "args": {"name": self.node_id}}] + events
+            doc["node_id"] = self.node_id
+            doc["epoch_unix_s"] = self.epoch_unix_s
+            doc["epoch_perf_us"] = self.epoch_perf_us
+        return doc
 
     def write(self, path: str) -> str:
         with open(path, "w") as f:

@@ -201,6 +201,13 @@ class Node:
         # gossip wakeup/poll and wire-encode-cache counters
         self.consensus_state.wal.metrics = self.metrics.consensus
         self.consensus_reactor.set_metrics(self.metrics.consensus)
+        # observability plane: the per-height stage timeline observes
+        # tendermint_consensus_stage_seconds{stage} when a height seals,
+        # and the (process-global) tracer reports ring saturation
+        self.consensus_state.timeline.metrics = self.metrics.consensus
+        from .libs.trace import tracer as _tracer
+
+        _tracer.drop_counter = self.metrics.trace_dropped_events_total
         self.mempool.metrics = self.metrics.mempool
         self.block_exec.metrics = self.metrics.state
         from .p2p.conn.mconnection import set_p2p_metrics

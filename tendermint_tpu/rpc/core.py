@@ -282,6 +282,17 @@ class Environment:
             })
         return {"round_state": round_state, "peers": peers}
 
+    async def consensus_stage_timeline(self, limit: int = 20) -> Dict[str, Any]:
+        """Per-height consensus stage timeline tail (consensus/timeline.py):
+        the newest ``limit`` sealed heights' stage marks and durations plus
+        the in-flight height — the RPC view of the bounded in-memory ring
+        the stage_seconds histograms are derived from."""
+        tl = getattr(self.node.consensus_state, "timeline", None)
+        if tl is None:
+            return {"capacity": 0, "heights_sealed": 0,
+                    "current": None, "heights": []}
+        return tl.snapshot(int(limit))
+
     async def check_tx(self, tx: str = "") -> Dict[str, Any]:
         """(rpc/core/mempool.go CheckTx route) run CheckTx against the app
         WITHOUT adding to the mempool."""
@@ -527,7 +538,7 @@ ROUTES = [
     "health", "status", "net_info", "genesis", "genesis_chunked",
     "blockchain", "block", "block_by_hash", "block_results", "commit",
     "check_tx", "validators", "consensus_state", "dump_consensus_state",
-    "consensus_params", "abci_info", "abci_query",
+    "consensus_stage_timeline", "consensus_params", "abci_info", "abci_query",
     "unconfirmed_txs", "num_unconfirmed_txs", "broadcast_tx_async",
     "broadcast_tx_sync", "broadcast_tx_commit", "broadcast_evidence",
     "tx", "tx_search", "block_search",

@@ -1,0 +1,124 @@
+"""tools/bench_compare.py: the bench-regression gate — exit codes,
+per-metric thresholds, lower-is-better latency gating, driver-format
+parsing, and the real BENCH_r*.json history staying machine-checkable."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(__file__))
+TOOL = os.path.join(REPO, "tools", "bench_compare.py")
+
+
+def _mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_compare
+
+        return bench_compare
+    finally:
+        sys.path.pop(0)
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, TOOL, *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _write(path, metrics):
+    with open(path, "w") as f:
+        for m, (v, unit) in metrics.items():
+            f.write(json.dumps({"metric": m, "value": v, "unit": unit,
+                                "vs_baseline": 1.0}) + "\n")
+
+
+def test_self_test_passes():
+    res = _run("--self-test")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "self-test OK" in res.stdout
+
+
+def test_real_history_gates_clean():
+    """r04 -> r05 was an improvement round: the gate must pass, and the
+    repo's checked-in history must stay parseable forever."""
+    r04 = os.path.join(REPO, "BENCH_r04.json")
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    res = _run(r04, r05)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK: no regressions" in res.stdout
+    bc = _mod()
+    run = bc.load_bench(r05)
+    assert run["verify_commit_10k_sigs_per_sec"]["value"] > 150000
+
+
+def test_degraded_flagship_trips_gate(tmp_path):
+    bc = _mod()
+    r05 = bc.load_bench(os.path.join(REPO, "BENCH_r05.json"))
+    degraded = dict(r05)
+    rec = dict(degraded["verify_commit_10k_sigs_per_sec"])
+    rec["value"] = rec["value"] * 0.5  # 50% < the 30% default threshold
+    degraded["verify_commit_10k_sigs_per_sec"] = rec
+    new = str(tmp_path / "new.json")
+    with open(new, "w") as f:
+        for line in degraded.values():
+            f.write(json.dumps(line) + "\n")
+    res = _run(os.path.join(REPO, "BENCH_r05.json"), new)
+    assert res.returncode == 1, res.stdout
+    assert "REGRESSION" in res.stdout
+    assert "verify_commit_10k_sigs_per_sec" in res.stdout
+    # loosening that one metric's threshold un-trips it
+    res2 = _run("--threshold", "verify_commit_10k_sigs_per_sec=0.6",
+                os.path.join(REPO, "BENCH_r05.json"), new)
+    assert res2.returncode == 0, res2.stdout
+
+
+def test_latency_gated_lower_is_better(tmp_path):
+    old, new = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+    _write(old, {"localnet_4node_tx_commit_latency_p50": (1.0, "s")})
+    _write(new, {"localnet_4node_tx_commit_latency_p50": (1.6, "s")})
+    assert _run(old, new).returncode == 1
+    _write(new, {"localnet_4node_tx_commit_latency_p50": (0.5, "s")})
+    res = _run(old, new)
+    assert res.returncode == 0
+    assert "improved" in res.stdout
+
+
+def test_missing_gated_metric_fails(tmp_path):
+    old, new = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+    _write(old, {"verify_commit_10k_sigs_per_sec": (100.0, "sigs/s"),
+                 "some_breakdown_share": (0.5, "ratio")})
+    _write(new, {"some_breakdown_share": (0.9, "ratio")})
+    res = _run(old, new)
+    assert res.returncode == 1
+    assert "MISSING" in res.stdout
+
+
+def test_trajectory_table_over_history():
+    files = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in (3, 4, 5)]
+    res = _run(*files)
+    assert res.returncode == 0, res.stdout + res.stderr
+    # all three runs' flagship values appear in one row
+    line = next(l for l in res.stdout.splitlines()
+                if l.startswith("verify_commit_10k_sigs_per_sec "))
+    assert "157880" in line and "47384" in line
+
+
+def test_parse_error_exits_2(tmp_path):
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("not a bench file\n")
+    res = _run(bad, bad)
+    assert res.returncode == 2
+    assert "no bench metric lines" in res.stderr
+
+
+def test_json_output(tmp_path):
+    old, new = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+    _write(old, {"verify_commit_10k_sigs_per_sec": (100.0, "sigs/s")})
+    _write(new, {"verify_commit_10k_sigs_per_sec": (10.0, "sigs/s")})
+    res = _run("--json", old, new)
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert doc["regressions"] == 1
+    assert doc["rows"][0]["status"] == "regressed"

@@ -1,0 +1,102 @@
+"""tools/fleet_scrape.py: Prometheus text parsing, cluster rollups
+(min/median/max per series, cluster blocks/min from the height MAX,
+wakeups per peer link), live endpoint addition, and the CLI self-test."""
+
+import os
+import subprocess
+import sys
+import time
+
+TOOL = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                    "tools", "fleet_scrape.py")
+
+
+def _mod():
+    sys.path.insert(0, os.path.dirname(TOOL))
+    try:
+        import fleet_scrape
+
+        return fleet_scrape
+    finally:
+        sys.path.pop(0)
+
+
+def test_self_test_passes():
+    res = subprocess.run([sys.executable, TOOL, "--self-test"],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "self-test OK" in res.stdout
+
+
+def test_parse_metrics_skips_buckets_and_comments():
+    fs = _mod()
+    text = "\n".join([
+        "# HELP tendermint_consensus_height x",
+        "# TYPE tendermint_consensus_height gauge",
+        "tendermint_consensus_height 42",
+        'tendermint_crypto_batch_size_bucket{le="4",plane="light"} 7',
+        'tendermint_crypto_batch_size_sum{plane="light"} 99.5',
+        'tendermint_consensus_gossip_wakeups_total{routine="data"} 12',
+        "garbage line without value collapses",
+        "tendermint_bad_value nan-ish",  # float('nan-ish') raises -> skip
+    ])
+    out = fs.parse_metrics(text)
+    assert out["tendermint_consensus_height"] == 42.0
+    assert out['tendermint_crypto_batch_size_sum{plane="light"}'] == 99.5
+    assert out['tendermint_consensus_gossip_wakeups_total'
+               '{routine="data"}'] == 12.0
+    assert not any("_bucket" in k for k in out)
+    assert "tendermint_bad_value" not in out
+
+
+def test_rollup_from_injected_samples():
+    """Rollup math without HTTP: samples injected straight into the
+    scraper's first/last stores (the exact shape sweep() records)."""
+    fs = _mod()
+    sc = fs.FleetScraper({})
+    t0 = time.time() - 30.0
+    heights_first = {"a": 10.0, "b": 10.0, "c": 9.0}
+    heights_last = {"a": 24.0, "b": 25.0, "c": 20.0}
+    for n in ("a", "b", "c"):
+        first = {"tendermint_consensus_committed_height": heights_first[n],
+                 'tendermint_consensus_gossip_wakeups_total'
+                 '{routine="data"}': 100.0}
+        last = {"tendermint_consensus_committed_height": heights_last[n],
+                'tendermint_consensus_gossip_wakeups_total'
+                '{routine="data"}': 160.0}
+        sc.first[n] = (t0, first)
+        sc.last[n] = (t0 + 30.0, last)
+    roll = sc.rollup()
+    hs = roll["series"]["tendermint_consensus_committed_height"]
+    assert (hs["min"], hs["median"], hs["max"]) == (20.0, 24.0, 25.0)
+    # cluster truth: max(25) - max(10) = 15 blocks over 30s -> 30/min
+    assert roll["cluster_blocks_per_min"] == 30.0
+    assert roll["cluster_height"] == 25.0
+    # 3 nodes x +60 wakeups over 6 directed links
+    assert roll["wakeups_per_peer_link"] == 30.0
+
+
+def test_add_endpoint_and_dead_node_degrade():
+    fs = _mod()
+    sc = fs.FleetScraper({"gone": "http://127.0.0.1:9/metrics"},
+                         interval_s=0.05)
+    assert sc.sweep() == 0
+    assert sc.errors == 1
+    sc.add_endpoint("also-gone", "http://127.0.0.1:9/metrics")
+    assert sc.sweep() == 0
+    assert sc.errors == 3
+    roll = sc.rollup()
+    assert roll["n_nodes"] == 0 and roll["scrape_errors"] == 3
+    assert roll["wakeups_per_peer_link"] == 0.0
+    assert "cluster_blocks_per_min" not in roll
+
+
+def test_write_is_atomic(tmp_path):
+    fs = _mod()
+    sc = fs.FleetScraper({})
+    path = str(tmp_path / "fleet.json")
+    sc.write(path)
+    import json
+
+    assert json.load(open(path))["n_nodes"] == 0
+    assert not os.path.exists(path + ".tmp")

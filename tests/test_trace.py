@@ -73,6 +73,60 @@ def test_enable_disable_clear():
     assert t.events() == []
 
 
+def test_dropped_counter_counts_ring_overflow():
+    t = Tracer(capacity=16, enabled=True)
+    for i in range(20):
+        with t.span("s", i=i):
+            pass
+    assert t.dropped == 4
+    assert t.chrome_trace()["dropped"] == 4
+    t.clear()
+    assert t.dropped == 0
+    # the metrics hook sees every drop (NodeMetrics.trace_dropped_events_total)
+    from tendermint_tpu.libs.metrics import NodeMetrics
+
+    m = NodeMetrics()
+    t.drop_counter = m.trace_dropped_events_total
+    for i in range(18):
+        t.instant("x", i=i)
+    assert m.trace_dropped_events_total.value() == 2
+    assert "tendermint_trace_dropped_events_total 2" in m.registry.render()
+
+
+def test_identity_header_and_process_name_metadata():
+    t = Tracer(capacity=8, enabled=True)
+    with t.span("a"):
+        pass
+    # without identity: plain container, no metadata event
+    doc = t.chrome_trace()
+    assert "node_id" not in doc
+    assert all(e.get("ph") != "M" for e in doc["traceEvents"])
+    t.set_identity("node3")
+    doc = t.chrome_trace()
+    assert doc["node_id"] == "node3"
+    assert doc["epoch_unix_s"] > 0 and doc["epoch_perf_us"] > 0
+    meta = doc["traceEvents"][0]
+    assert meta["ph"] == "M" and meta["args"]["name"] == "node3"
+    # the wall<->perf epoch pair describes ONE instant: converting the
+    # span's perf ts through it lands within a second of now
+    import time
+
+    ev = doc["traceEvents"][1]
+    wall_s = doc["epoch_unix_s"] + (ev["ts"] - doc["epoch_perf_us"]) / 1e6
+    assert abs(wall_s - time.time()) < 1.0
+
+
+def test_complete_records_explicit_span():
+    t = Tracer(capacity=8, enabled=True)
+    t.complete("stage_prevote_quorum", 1000.0, 250.0, height=5, round=1)
+    (ev,) = t.events()
+    assert ev["ph"] == "X" and ev["ts"] == 1000.0 and ev["dur"] == 250.0
+    assert ev["args"] == {"height": 5, "round": 1}
+    t.disable()
+    t.complete("ignored", 0.0, 1.0)
+    assert len(t.events()) == 1
+
+
 def test_threaded_appends_all_land():
     t = Tracer(capacity=4096, enabled=True)
 

@@ -1,0 +1,103 @@
+"""tools/trace_merge.py: cross-node trace correlation — real tracer
+exports (libs/trace.py set_identity headers) merged onto one wall clock,
+per-node tracks, and the commit-skew report. Runs the tool both imported
+and as a subprocess so CLI plumbing is covered too."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+TOOL = os.path.join(TOOLS, "trace_merge.py")
+
+
+def _mod():
+    sys.path.insert(0, TOOLS)
+    try:
+        import trace_merge
+
+        return trace_merge
+    finally:
+        sys.path.pop(0)
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, TOOL, *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_self_test_passes():
+    res = _run("--self-test")
+    assert res.returncode == 0, res.stderr
+    assert "self-test OK" in res.stdout
+
+
+def _node_trace(path, node_id, heights, commit_offset_s):
+    """A REAL tracer export: identity header + stage spans laid down via
+    the same complete() call the timeline uses at seal."""
+    from tendermint_tpu.libs.trace import Tracer
+
+    t = Tracer(enabled=True)
+    t.set_identity(node_id)
+    base = time.perf_counter() * 1e6
+    for i, h in enumerate(heights):
+        end = base + (i + 1) * 1_000_000.0 + commit_offset_s * 1e6
+        t.complete("stage_prevote_quorum", end - 9000.0, 5000.0,
+                   height=h, round=0)
+        t.complete("stage_commit_finalized", end - 2000.0, 2000.0,
+                   height=h, round=0)
+    return t.write(path)
+
+
+def test_merge_real_tracer_exports(tmp_path):
+    tm = _mod()
+    p0 = _node_trace(str(tmp_path / "t0.json"), "node0", [4, 5, 6], 0.0)
+    p1 = _node_trace(str(tmp_path / "t1.json"), "node1", [4, 5, 6], 0.030)
+    docs = [(tm.node_label(tm.load_trace(p), p), tm.load_trace(p))
+            for p in (p0, p1)]
+    merged = tm.merge(docs)
+    assert merged["aligned"] is True
+    assert merged["nodes"] == ["node0", "node1"]
+    meta = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in meta} == {"node0", "node1"}
+    assert {e["pid"] for e in merged["traceEvents"]} == {1, 2}
+    report = tm.skew_report(docs)
+    assert report["heights"] == 3
+    # both tracers run in THIS process (one wall clock): the injected 30ms
+    # offset must come back, modulo the per-call clock-sampling jitter of
+    # set_identity (two clocks read non-atomically)
+    assert 25.0 < report["mean_spread_ms"] < 35.0, report
+    assert all(r["first"] == "node0" and r["last"] == "node1"
+               for r in report["per_height"])
+    for s in report["slowest_stage_per_node"].values():
+        assert s["slowest_stage"] == "prevote_quorum"
+
+
+def test_cli_merge_and_skew(tmp_path):
+    p0 = _node_trace(str(tmp_path / "a.json"), "node-a", [2, 3], 0.0)
+    p1 = _node_trace(str(tmp_path / "b.json"), "node-b", [2, 3], 0.050)
+    out = str(tmp_path / "merged.json")
+    res = _run(p0, p1, "--out", out)
+    assert res.returncode == 0, res.stderr
+    assert "wrote merged trace for 2 nodes" in res.stdout
+    assert "node-a -> node-b" in res.stdout
+    doc = json.load(open(out))
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["aligned"] is True
+    # the merged file is itself a valid trace_summary input
+    res2 = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "trace_summary.py"),
+         "--json", out], capture_output=True, text=True, timeout=60)
+    assert res2.returncode == 0, res2.stderr
+    assert "stage_commit_finalized" in json.loads(res2.stdout)
+    # JSON skew report
+    res3 = _run(p0, p1, "--json")
+    report = json.loads(res3.stdout)
+    assert report["heights"] == 2 and report["max_spread_ms"] > 0
+
+
+def test_single_file_errors():
+    res = _run("only-one.json")
+    assert res.returncode != 0

@@ -1,0 +1,310 @@
+"""Bench-regression gate: diff BENCH_r*.json runs, flag regressions, exit
+nonzero.
+
+The r01→r05 trajectory (flagship 8.0x → 23.0x) has been folklore checked by
+eyeball; this makes it a machine-checked invariant:
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_new.json
+    python tools/bench_compare.py BENCH_r0*.json new.json   # trajectory too
+    python tools/bench_compare.py --threshold \
+        verify_commit_10k_sigs_per_sec=0.2 old.json new.json
+    python tools/bench_compare.py --self-test
+
+Accepted inputs: the driver's record format ({"tail": "<jsonl>", ...}), a
+raw bench.py JSONL stream, or a JSON array of metric lines. The NEWEST file
+(last argument) is gated against the one before it; earlier files only feed
+the trajectory table.
+
+Gating policy, by the bench's own unit conventions:
+* throughput units (sigs/s, blocks/s, blocks/min): higher is better —
+  regression when new < old * (1 - threshold);
+* latency unit (s): lower is better — regression when
+  new > old * (1 + threshold);
+* informational units (ratio, events, ms/height, error) and *_failed
+  markers: reported, never gated.
+
+The default threshold is deliberately loose (30%): the TPU relay's
+effective bandwidth swings hour to hour (PROFILE_r05), and a gate that
+cries wolf gets deleted. Tighten per-metric with --threshold NAME=FRAC.
+
+Exit codes: 0 clean, 1 regression(s), 2 usage/parse error. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_THRESHOLD = 0.30
+
+#: units gated as higher-is-better throughput
+HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s"}
+#: units gated as lower-is-better latency
+LOWER_BETTER_UNITS = {"s", "ms"}
+
+
+def load_bench(path: str) -> Dict[str, dict]:
+    """{metric: line} from a driver record, raw JSONL, or a JSON array.
+    Later lines win (bench emits each metric once; reruns append)."""
+    with open(path) as f:
+        text = f.read()
+    lines: List[str] = []
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        lines = str(doc["tail"]).splitlines()
+    elif isinstance(doc, dict) and "metric" in doc:
+        lines = [text]
+    elif isinstance(doc, list):
+        lines = [json.dumps(e) for e in doc]
+    else:
+        lines = text.splitlines()
+    out: Dict[str, dict] = {}
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out[rec["metric"]] = rec
+    if not out:
+        raise ValueError(f"{path}: no bench metric lines found")
+    return out
+
+
+def gate_direction(metric: str, unit: str) -> Optional[str]:
+    """'up' (higher better), 'down' (lower better), or None (not gated)."""
+    if metric.endswith("_failed") or "_breakdown" in metric \
+            or metric == "trace_summary":
+        return None
+    if unit in HIGHER_BETTER_UNITS:
+        return "up"
+    if unit in LOWER_BETTER_UNITS:
+        return "down"
+    return None
+
+
+def compare(old: Dict[str, dict], new: Dict[str, dict],
+            thresholds: Dict[str, float],
+            default_threshold: float = DEFAULT_THRESHOLD) -> List[dict]:
+    """Per-metric verdicts for every metric in either run."""
+    rows: List[dict] = []
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric), new.get(metric)
+        # direction comes from the OLD record's unit when it exists: a
+        # crashed config re-emits its metric with unit "error" (bench.py's
+        # except paths), and taking the new unit would silently un-gate it
+        unit = (o or n).get("unit", "")
+        direction = gate_direction(metric, unit)
+        thr = thresholds.get(metric, default_threshold)
+        row = {"metric": metric, "unit": unit,
+               "old": o["value"] if o else None,
+               "new": n["value"] if n else None,
+               "direction": direction, "threshold": thr}
+        if direction is None:
+            row["status"] = "info"
+        elif o is None:
+            row["status"] = "new"
+        elif n is None:
+            # the metric vanished — the config crashed or was deleted; a
+            # silent disappearance must not read as "no regression"
+            row["status"] = "missing"
+        elif gate_direction(metric, n.get("unit", "")) != direction:
+            # a gated metric flipped to a non-gated unit ("error"): the
+            # config crashed — must not read as "no regression"
+            row["status"] = "errored"
+        else:
+            ratio = (n["value"] / o["value"]) if o["value"] else float("inf")
+            row["ratio"] = round(ratio, 3)
+            if direction == "up":
+                regressed = n["value"] < o["value"] * (1.0 - thr)
+                improved = n["value"] > o["value"] * (1.0 + thr)
+            else:
+                regressed = n["value"] > o["value"] * (1.0 + thr)
+                improved = n["value"] < o["value"] * (1.0 - thr)
+            row["status"] = ("regressed" if regressed
+                             else "improved" if improved else "ok")
+        rows.append(row)
+    return rows
+
+
+def trajectory(runs: List[Dict[str, dict]], labels: List[str]) -> str:
+    """metric × run table over every gated metric present anywhere."""
+    metrics = sorted({m for run in runs for m in run
+                      if gate_direction(m, run[m].get("unit", ""))
+                      is not None})
+    if not metrics:
+        return "(no gated metrics)"
+    w = max(len(m) for m in metrics)
+    cols = [f"{lab[-14:]:>14}" for lab in labels]
+    lines = [f"{'metric':<{w}}  " + "  ".join(cols)]
+    for m in metrics:
+        cells = []
+        for run in runs:
+            v = run.get(m, {}).get("value")
+            cells.append(f"{v:>14.3f}" if isinstance(v, (int, float))
+                         else f"{'-':>14}")
+        lines.append(f"{m:<{w}}  " + "  ".join(cells))
+    return "\n".join(lines)
+
+
+def render(rows: List[dict]) -> str:
+    w = max(len(r["metric"]) for r in rows)
+    lines = [f"{'metric':<{w}}  {'old':>14}  {'new':>14}  {'ratio':>7}  "
+             f"status"]
+    for r in rows:
+        old = f"{r['old']:.3f}" if isinstance(r["old"], (int, float)) else "-"
+        new = f"{r['new']:.3f}" if isinstance(r["new"], (int, float)) else "-"
+        ratio = f"{r['ratio']:.3f}" if "ratio" in r else "-"
+        mark = {"regressed": " <-- REGRESSION",
+                "missing": " <-- MISSING",
+                "errored": " <-- ERRORED"}.get(r["status"], "")
+        lines.append(f"{r['metric']:<{w}}  {old:>14}  {new:>14}  "
+                     f"{ratio:>7}  {r['status']}{mark}")
+    return "\n".join(lines)
+
+
+def parse_thresholds(pairs: List[str]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for p in pairs:
+        name, _, frac = p.partition("=")
+        if not name or not frac:
+            raise ValueError(f"--threshold wants NAME=FRACTION, got {p!r}")
+        out[name] = float(frac)
+    return out
+
+
+# -- self-test ----------------------------------------------------------------
+
+def _write(path: str, metrics: Dict[str, tuple]) -> None:
+    with open(path, "w") as f:
+        for m, (v, unit) in metrics.items():
+            f.write(json.dumps({"metric": m, "value": v, "unit": unit,
+                                "vs_baseline": 1.0}) + "\n")
+
+
+def self_test() -> int:
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="bench-compare-")
+    try:
+        base = os.path.join(d, "old.json")
+        _write(base, {"verify_commit_10k_sigs_per_sec": (157000.0, "sigs/s"),
+                      "localnet_4node_tx_commit_latency_p50": (1.1, "s"),
+                      "verify_commit_10k_breakdown_pack_share":
+                          (0.11, "ratio")})
+        # within the 30% window on throughput and latency: clean
+        ok = os.path.join(d, "ok.json")
+        _write(ok, {"verify_commit_10k_sigs_per_sec": (140000.0, "sigs/s"),
+                    "localnet_4node_tx_commit_latency_p50": (1.3, "s"),
+                    "verify_commit_10k_breakdown_pack_share":
+                        (0.50, "ratio")})
+        assert main([base, ok]) == 0
+        # flagship degraded 60%: gate trips
+        bad = os.path.join(d, "bad.json")
+        _write(bad, {"verify_commit_10k_sigs_per_sec": (60000.0, "sigs/s"),
+                     "localnet_4node_tx_commit_latency_p50": (1.0, "s")})
+        assert main([base, bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(base), load_bench(bad), {})}
+        assert rows["verify_commit_10k_sigs_per_sec"]["status"] == "regressed"
+        # latency is gated lower-is-better
+        slow = os.path.join(d, "slow.json")
+        _write(slow, {"verify_commit_10k_sigs_per_sec": (157000.0, "sigs/s"),
+                      "localnet_4node_tx_commit_latency_p50": (2.0, "s")})
+        assert main([base, slow]) == 1
+        # a VANISHED gated metric is a failure, an informational one is not
+        gone = os.path.join(d, "gone.json")
+        _write(gone, {"localnet_4node_tx_commit_latency_p50": (1.1, "s")})
+        assert main([base, gone]) == 1
+        # a gated metric re-emitted with unit "error" (bench's crashed-
+        # config convention) is a failure, not an un-gated info row
+        err = os.path.join(d, "err.json")
+        _write(err, {"verify_commit_10k_sigs_per_sec": (0.0, "error"),
+                     "localnet_4node_tx_commit_latency_p50": (1.1, "s")})
+        assert main([base, err]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(base), load_bench(err), {})}
+        assert rows["verify_commit_10k_sigs_per_sec"]["status"] == "errored"
+        # per-metric threshold override loosens the gate
+        assert main(["--threshold", "verify_commit_10k_sigs_per_sec=0.9",
+                     "--threshold",
+                     "localnet_4node_tx_commit_latency_p50=2.0",
+                     base, bad]) == 0
+        # the driver's record format ({"tail": jsonl}) parses identically
+        drv = os.path.join(d, "driver.json")
+        with open(drv, "w") as f:
+            json.dump({"n": 5, "rc": 0, "tail": "noise\n" + json.dumps(
+                {"metric": "verify_commit_10k_sigs_per_sec",
+                 "value": 150000.0, "unit": "sigs/s",
+                 "vs_baseline": 22.0}) + "\n"}, f)
+        assert load_bench(drv)[
+            "verify_commit_10k_sigs_per_sec"]["value"] == 150000.0
+        assert main([drv, ok]) == 0
+        # trajectory across 3 runs renders every gated metric
+        table = trajectory([load_bench(p) for p in (base, ok, bad)],
+                           ["r01", "r02", "r03"])
+        assert "verify_commit_10k_sigs_per_sec" in table
+        assert "breakdown" not in table
+    finally:
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+    print("bench_compare self-test OK (gates, thresholds, formats)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("runs", nargs="*",
+                    help="bench result files, oldest first; the last is "
+                         "gated against the one before it")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric regression threshold (repeatable)")
+    ap.add_argument("--default-threshold", type=float,
+                    default=DEFAULT_THRESHOLD)
+    ap.add_argument("--json", action="store_true",
+                    help="print the comparison rows as JSON")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if len(args.runs) < 2:
+        ap.error("need at least two run files (or --self-test)")
+    try:
+        thresholds = parse_thresholds(args.threshold)
+        runs = [load_bench(p) for p in args.runs]
+    except (ValueError, OSError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    rows = compare(runs[-2], runs[-1], thresholds, args.default_threshold)
+    bad = [r for r in rows
+           if r["status"] in ("regressed", "missing", "errored")]
+    if args.json:
+        print(json.dumps({"rows": rows, "regressions": len(bad)}, indent=2))
+        return 1 if bad else 0
+    if len(runs) > 2:
+        print(trajectory(runs, list(args.runs)))
+        print()
+    print(render(rows))
+    print()
+    if bad:
+        print(f"FAIL: {len(bad)} regression(s) beyond threshold: "
+              + ", ".join(r["metric"] for r in bad))
+        return 1
+    print(f"OK: no regressions across {sum(1 for r in rows if r['direction'])}"
+          " gated metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

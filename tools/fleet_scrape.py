@@ -1,0 +1,375 @@
+"""Fleet metrics aggregator: scrape every node's /metrics, roll up cluster
+truth.
+
+A 4-node (or 32-node) run reported through node0's /metrics answers "how is
+node0", not "how is the cluster". This scraper polls all nodes' Prometheus
+endpoints on an interval and emits cluster rollups:
+
+* per-series min / median / max across nodes (last sample),
+* cross-node blocks/min: committed-height delta of the cluster MAX between
+  the first and last scrape — the chain's real rate, immune to one
+  lagging node,
+* gossip wakeups-per-peer-link (sum of wakeup deltas / directed links),
+
+as JSON consumed by bench config 4 and the e2e runner (which also exports
+the path via TMTPU_FLEET_JSON so node debugdump bundles can include the
+snapshot).
+
+    python tools/fleet_scrape.py --ports 28664,28665,28666,28667 \
+        --duration 30 --interval 2 --out fleet.json
+    python tools/fleet_scrape.py --self-test
+
+Stdlib-only on purpose: it runs inside bench/e2e harnesses and on boxes
+that can't import the package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_NAMESPACE = "tendermint"
+
+
+def parse_metrics(text: str) -> Dict[str, float]:
+    """Prometheus text exposition -> {series: value}; series is
+    ``name`` or ``name{labels}`` verbatim. Histogram bucket lines are
+    skipped (the rollup works on sums/counts/gauges/counters)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+        except ValueError:
+            continue
+        name = series.split("{", 1)[0]
+        if name.endswith("_bucket"):
+            continue
+        try:
+            out[series] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def scrape_endpoint(url: str, timeout: float = 2.0) -> Dict[str, float]:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return parse_metrics(r.read().decode())
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+class FleetScraper:
+    """Poll N /metrics endpoints on an interval; rollup() aggregates."""
+
+    def __init__(self, endpoints: Dict[str, str], interval_s: float = 2.0,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 out_path: Optional[str] = None):
+        """``endpoints`` maps node name -> /metrics URL. ``out_path``, if
+        set, gets a fresh rollup JSON after every sweep (the debugdump
+        seam: TMTPU_FLEET_JSON points nodes at this file)."""
+        self.endpoints = dict(endpoints)
+        self.interval_s = interval_s
+        self.namespace = namespace
+        self.out_path = out_path
+        self.first: Dict[str, Tuple[float, Dict[str, float]]] = {}
+        self.last: Dict[str, Tuple[float, Dict[str, float]]] = {}
+        self.scrapes = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- sampling ----------------------------------------------------------
+
+    def add_endpoint(self, name: str, url: str) -> None:
+        """Safe while the loop runs (late-joining nodes)."""
+        self.endpoints[name] = url
+
+    def sweep(self) -> int:
+        """Scrape every endpoint once, concurrently; returns how many
+        answered. Concurrency matters at fleet scale: serially, a few
+        wedged-but-listening nodes (2s urlopen timeout each — exactly the
+        stall scenario the debugdump snapshot targets) would stretch one
+        sweep past interval_s and stale the rollup."""
+
+        def one(name: str, url: str):
+            try:
+                return name, scrape_endpoint(url), time.time()
+            except Exception:
+                return name, None, 0.0
+
+        ok = 0
+        items = list(self.endpoints.items())
+        if items:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(16, len(items))) as ex:
+                for name, sample, now in ex.map(lambda kv: one(*kv), items):
+                    if sample is None:
+                        self.errors += 1
+                        continue
+                    with self._lock:
+                        self.first.setdefault(name, (now, sample))
+                        self.last[name] = (now, sample)
+                    ok += 1
+        self.scrapes += 1
+        if self.out_path:
+            try:
+                self.write(self.out_path)
+            except Exception:
+                pass
+        return ok
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.sweep()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "FleetScraper":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-scrape")
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        """Stop the loop, take one final sweep, return the rollup."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval_s + 5.0)
+            self._thread = None
+        self.sweep()
+        return self.rollup()
+
+    # -- aggregation -------------------------------------------------------
+
+    def _series_name(self, suffix: str) -> str:
+        return f"{self.namespace}_{suffix}" if self.namespace else suffix
+
+    def rollup(self) -> dict:
+        with self._lock:
+            first = dict(self.first)
+            last = dict(self.last)
+        nodes = sorted(last)
+        series: Dict[str, dict] = {}
+        all_names = sorted({s for _, sample in last.values()
+                            for s in sample})
+        for s in all_names:
+            vals = [last[n][1][s] for n in nodes if s in last[n][1]]
+            if not vals:
+                continue
+            series[s] = {"min": min(vals), "median": _median(vals),
+                         "max": max(vals), "nodes": len(vals)}
+        # cluster blocks/min from the committed-height series: the cluster
+        # commits a height when ANY node does, so cluster truth is the MAX
+        # across nodes at each sample point
+        height_s = self._series_name("consensus_committed_height")
+        out = {
+            "nodes": nodes,
+            "n_nodes": len(nodes),
+            "scrapes": self.scrapes,
+            "scrape_errors": self.errors,
+            "series": series,
+        }
+        h_first = [first[n][1].get(height_s) for n in nodes
+                   if height_s in first[n][1]]
+        h_last = [last[n][1].get(height_s) for n in nodes
+                  if height_s in last[n][1]]
+        if h_first and h_last:
+            t_first = min(first[n][0] for n in nodes)
+            t_last = max(last[n][0] for n in nodes)
+            elapsed = max(1e-9, t_last - t_first)
+            blocks = max(h_last) - max(h_first)
+            out["elapsed_s"] = round(elapsed, 3)
+            out["cluster_height"] = max(h_last)
+            out["cluster_blocks_per_min"] = round(blocks / elapsed * 60.0, 3)
+        # gossip wakeups per directed peer link, from counter deltas summed
+        # across nodes (each of the n nodes runs routines per peer)
+        wake_prefix = self._series_name("consensus_gossip_wakeups_total")
+        delta = 0.0
+        for n in nodes:
+            for s, v in last[n][1].items():
+                if s.split("{", 1)[0] == wake_prefix:
+                    # clamp at 0: a restarted node resets its counters
+                    # (Prometheus rate()-style counter-reset handling)
+                    delta += max(0.0, v - first[n][1].get(s, 0.0))
+        links = max(1, len(nodes) * (len(nodes) - 1))
+        out["gossip_wakeups_delta"] = delta
+        out["wakeups_per_peer_link"] = round(delta / links, 3)
+        return out
+
+    def write(self, path: str) -> str:
+        import os
+        import tempfile
+
+        doc = self.rollup()
+        # unique tmp per call: stop()'s final sweep can race a wedged
+        # worker sweep, and two writers on one shared tmp would tear it
+        fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".",
+                                   dir=os.path.dirname(path) or ".")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)  # readers (debugdump) never see a tear
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+
+# -- self-test ----------------------------------------------------------------
+
+def _serve_synthetic(n_nodes: int):
+    """Tiny per-node HTTP servers whose /metrics advance on every scrape:
+    node i's committed height starts at 10+i and gains 2 per request."""
+    import http.server
+
+    servers = []
+
+    def make_handler(state):
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                state["hits"] += 1
+                h = state["h0"] + 2 * state["hits"]
+                body = "\n".join([
+                    "# HELP tendermint_consensus_committed_height x",
+                    "# TYPE tendermint_consensus_committed_height gauge",
+                    f"tendermint_consensus_committed_height {h}",
+                    "tendermint_consensus_gossip_wakeups_total"
+                    '{routine="data"} ' + str(20 * state["hits"]),
+                    "tendermint_consensus_stage_seconds_sum"
+                    '{stage="commit_finalized"} 0.5',
+                    "tendermint_consensus_stage_seconds_count"
+                    '{stage="commit_finalized"} 10',
+                    'tendermint_consensus_stage_seconds_bucket'
+                    '{le="+Inf",stage="commit_finalized"} 10',
+                ]).encode() + b"\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        return H
+
+    for i in range(n_nodes):
+        state = {"h0": 10 + i, "hits": 0}
+        srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(state))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+    return servers
+
+
+def self_test() -> int:
+    servers = _serve_synthetic(3)
+    try:
+        endpoints = {f"node{i}": f"http://127.0.0.1:{s.server_address[1]}"
+                     "/metrics" for i, s in enumerate(servers)}
+        sc = FleetScraper(endpoints, interval_s=0.05)
+        assert sc.sweep() == 3
+        time.sleep(0.25)
+        assert sc.sweep() == 3
+        roll = sc.rollup()
+        assert roll["n_nodes"] == 3
+        assert roll["scrape_errors"] == 0
+        hs = roll["series"]["tendermint_consensus_committed_height"]
+        # second scrape: node i reports 10+i+4 -> min 14, max 16, median 15
+        assert (hs["min"], hs["median"], hs["max"]) == (14.0, 15.0, 16.0), hs
+        # bucket lines never enter the rollup
+        assert not any(s.startswith(
+            "tendermint_consensus_stage_seconds_bucket")
+            for s in roll["series"])
+        assert "tendermint_consensus_stage_seconds_sum" \
+            '{stage="commit_finalized"}' in roll["series"]
+        # cluster height is the MAX across nodes: node2's 12+2*2 = 16
+        assert roll["cluster_height"] == 16.0, roll
+        assert roll["cluster_blocks_per_min"] > 0
+        # wakeups: each node +20 per scrape -> delta 3*20 over 6 links
+        assert abs(roll["wakeups_per_peer_link"] - 10.0) < 0.001, roll
+        # threaded mode + out_path freshness
+        import os
+        import tempfile
+
+        fd, path = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            sc2 = FleetScraper(endpoints, interval_s=0.05,
+                               out_path=path).start()
+            time.sleep(0.3)
+            roll2 = sc2.stop()
+            assert roll2["scrapes"] >= 2
+            with open(path) as f:
+                on_disk = json.load(f)
+            assert on_disk["n_nodes"] == 3
+        finally:
+            os.unlink(path)
+        # a dead endpoint degrades to errors, not a crash
+        sc3 = FleetScraper({"gone": "http://127.0.0.1:9/metrics"},
+                           interval_s=0.05)
+        assert sc3.sweep() == 0 and sc3.errors == 1
+        assert sc3.rollup()["n_nodes"] == 0
+    finally:
+        for s in servers:
+            s.shutdown()
+    print("fleet_scrape self-test OK (3 nodes, rollup + cluster rate)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated name=url pairs (or bare urls)")
+    ap.add_argument("--ports", default="",
+                    help="comma-separated /metrics ports on --host")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--namespace", default=DEFAULT_NAMESPACE)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the final rollup JSON here "
+                         "(and keep it fresh during the run)")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    endpoints: Dict[str, str] = {}
+    for i, part in enumerate(p for p in args.endpoints.split(",") if p):
+        name, _, url = part.rpartition("=")
+        endpoints[name or f"node{i}"] = url
+    for i, port in enumerate(p for p in args.ports.split(",") if p):
+        endpoints[f"node{i}"] = f"http://{args.host}:{int(port)}/metrics"
+    if not endpoints:
+        ap.error("no endpoints (use --endpoints or --ports, or --self-test)")
+    sc = FleetScraper(endpoints, interval_s=args.interval,
+                      namespace=args.namespace, out_path=args.out).start()
+    try:
+        time.sleep(args.duration)
+    except KeyboardInterrupt:
+        pass
+    # stop()'s final sweep already refreshed args.out (the out_path seam)
+    roll = sc.stop()
+    print(json.dumps(roll, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,13 @@ did the window go" without opening Perfetto:
 
     python tools/trace_summary.py /tmp/bench-trace.json
     python tools/trace_summary.py --json /tmp/bench-trace.json   # machine-readable
+    python tools/trace_summary.py trace-*.json --node-prefix     # cluster view
     python tools/trace_summary.py --self-test                    # CI guard
+
+Multiple inputs (per-node traces, or tools/trace_merge.py output alongside
+originals) are summarized together; --node-prefix labels each file's spans
+``<node>:<span>`` (node id from the trace header, else the file stem) so
+per-node asymmetries stay visible in the combined table.
 
 Dependency-free on purpose (stdlib only, no package import): it must run
 against a dump bundle on a box that can't import jax.
@@ -22,17 +28,30 @@ from typing import Dict, List
 def load_events(path: str) -> List[dict]:
     """Accept both the {"traceEvents": [...]} container and a bare event
     array (both are valid Chrome trace JSON)."""
+    return load_labeled(path)[1]
+
+
+def load_labeled(path: str):
+    """(node label, events): label from the tracer's node_id export header
+    (libs/trace.py set_identity) when present, else the file stem."""
+    import os
+
     with open(path) as f:
         data = json.load(f)
+    label = os.path.splitext(os.path.basename(path))[0]
     if isinstance(data, dict):
         events = data.get("traceEvents", [])
+        if data.get("node_id"):
+            label = str(data["node_id"])
     elif isinstance(data, list):
         events = data
     else:
         raise ValueError(f"{path}: not a trace-event JSON")
     if not isinstance(events, list):
         raise ValueError(f"{path}: traceEvents is not a list")
-    return [e for e in events if isinstance(e, dict) and e.get("name")]
+    return label, [e for e in events
+                   if isinstance(e, dict) and e.get("name")
+                   and e.get("ph") != "M"]
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -148,6 +167,28 @@ def self_test() -> int:
     assert heights[5]["gossip_idle"] == 80.0
     assert heights[6]["wal_group"] == 3.0
     assert "gossip_idle" in render_by_height(heights)
+    # multi-file + --node-prefix composition (merged cluster traces): the
+    # node label comes from the export header, metadata events are skipped
+    fd2, path2 = tempfile.mkstemp(suffix=".json")
+    try:
+        with os.fdopen(fd2, "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 1,
+                 "args": {"name": "nodeX"}},
+                {"name": "verify_window", "ph": "X", "ts": 1.0, "dur": 7.0,
+                 "pid": 1, "tid": 1}],
+                "displayTimeUnit": "ms", "node_id": "nodeX"}, f)
+        label, evs = load_labeled(path2)
+        assert label == "nodeX" and len(evs) == 1, (label, evs)
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert main(["--json", "--node-prefix", path2]) == 0
+        assert "nodeX:verify_window" in buf.getvalue()
+    finally:
+        os.unlink(path2)
     print("trace_summary self-test OK "
           f"({len(summary)} spans, {sum(s['count'] for s in summary.values())}"
           f" events, {len(heights)} heights)")
@@ -156,13 +197,18 @@ def self_test() -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("trace", nargs="?", help="Chrome trace-event JSON path")
+    ap.add_argument("trace", nargs="*", help="Chrome trace-event JSON "
+                    "path(s); several per-node traces combine into one "
+                    "summary")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as JSON instead of a table")
     ap.add_argument("--by-height", action="store_true",
                     help="group height-tagged spans (gossip_idle, wal_group, "
-                         "apply_block, verify/apply windows) per height — "
-                         "the live-plane latency attribution view")
+                         "apply_block, verify/apply windows, stage_*) per "
+                         "height — the live-plane latency attribution view")
+    ap.add_argument("--node-prefix", action="store_true",
+                    help="label every span '<node>:<span>' per input file "
+                         "(node id from the trace header, else file stem)")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in round-trip check and exit")
     args = ap.parse_args(argv)
@@ -170,7 +216,16 @@ def main(argv=None) -> int:
         return self_test()
     if not args.trace:
         ap.error("trace path required (or --self-test)")
-    events = load_events(args.trace)
+    events = []
+    for path in args.trace:
+        label, evs = load_labeled(path)
+        if args.node_prefix:
+            for e in evs:
+                e = dict(e)
+                e["name"] = f"{label}:{e['name']}"
+                events.append(e)
+        else:
+            events.extend(evs)
     if args.by_height:
         table = by_height(events)
         if args.json:
