@@ -129,9 +129,16 @@ class SnapshotKVStoreApplication(KVStoreApplication):
                        ) -> abci.ResponseListSnapshots:
         out = []
         for h, chunks in sorted(self._snapshots.items()):
+            # metadata carries per-chunk hashes (the reference e2e app's
+            # trick): restore can then verify EACH chunk as it arrives and
+            # blame the specific sender of a corrupted one, instead of
+            # discovering a whole-blob mismatch at the end with no culprit
+            meta = json.dumps({"chunk_hashes": [
+                hashlib.sha256(c).hexdigest() for c in chunks]}).encode()
             out.append(abci.Snapshot(
                 height=h, format=1, chunks=len(chunks),
-                hash=hashlib.sha256(b"".join(chunks)).digest()))
+                hash=hashlib.sha256(b"".join(chunks)).digest(),
+                metadata=meta))
         return abci.ResponseListSnapshots(snapshots=out)
 
     def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk
@@ -147,7 +154,10 @@ class SnapshotKVStoreApplication(KVStoreApplication):
             return abci.ResponseOfferSnapshot(
                 result=abci.OFFER_SNAPSHOT_REJECT_FORMAT)
         self._restore = {"snapshot": req.snapshot, "app_hash": req.app_hash,
-                         "chunks": []}
+                         "chunks": [],
+                         # parsed once here: apply_snapshot_chunk runs per
+                         # chunk and must not re-decode an O(chunks) list
+                         "chunk_hashes": _parse_chunk_hashes(req.snapshot)}
         return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
 
     def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk
@@ -155,6 +165,16 @@ class SnapshotKVStoreApplication(KVStoreApplication):
         if self._restore is None:
             return abci.ResponseApplySnapshotChunk(
                 result=abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT)
+        expected = self._restore["chunk_hashes"]
+        if (expected is not None
+                and hashlib.sha256(req.chunk).hexdigest()
+                != expected[req.index]):
+            # corrupted chunk from an untrusted peer: don't apply it — ask
+            # for a refetch and name the sender so the syncer can ban it
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_SNAPSHOT_CHUNK_RETRY,
+                refetch_chunks=[req.index],
+                reject_senders=[req.sender] if req.sender else [])
         self._restore["chunks"].append(req.chunk)
         snap = self._restore["snapshot"]
         if len(self._restore["chunks"]) == snap.chunks:
@@ -172,6 +192,20 @@ class SnapshotKVStoreApplication(KVStoreApplication):
             self._restore = None
         return abci.ResponseApplySnapshotChunk(
             result=abci.APPLY_SNAPSHOT_CHUNK_ACCEPT)
+
+
+def _parse_chunk_hashes(snap: abci.Snapshot) -> Optional[List[str]]:
+    """Per-chunk sha256 hexdigests from a snapshot's metadata; None when
+    absent/garbled (older snapshots, or a lying advertiser — the final
+    whole-blob check still guards those)."""
+    try:
+        hashes = json.loads(snap.metadata.decode())["chunk_hashes"]
+    except Exception:
+        return None
+    if (not isinstance(hashes, list) or len(hashes) != snap.chunks
+            or not all(isinstance(x, str) for x in hashes)):
+        return None
+    return hashes
 
 
 def tx_is_validator_update(tx: bytes) -> bool:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
@@ -45,7 +46,9 @@ from ..store import BlockStore
 from ..types.basic import BlockID
 from ..types.block import Block
 from ..crypto.batch import BatchVerifier, precomputed_verdicts
+from ..libs.faults import faults
 from ..libs.metrics import BlocksyncMetrics, Registry
+from ..libs.peerscore import PeerScoreboard
 from ..libs.trace import tracer
 from ..types.validator_set import verify_commit_light_batched
 from .msgs import (
@@ -118,6 +121,18 @@ class BlockchainReactor(Reactor):
         # keep this private set. bench.py derives the old stage_times
         # breakdown from the histogram sums via stage_breakdown().
         self.metrics = BlocksyncMetrics(Registry())
+        # untrusted-provider scoring (libs/peerscore.py): a bad block is a
+        # strike — exponential backoff keeps the offender out of the pool,
+        # ban_threshold strikes disconnect it. Threshold 2 (not 1): over a
+        # Byzantine wire a single tampered response may be the LINK lying,
+        # not the peer; a repeat offender is disconnected either way.
+        self.scoreboard = PeerScoreboard(
+            ban_threshold=int(
+                os.environ.get("TMTPU_BLOCKSYNC_BAN_THRESHOLD") or 2),
+            seed=faults.seed, name="blocksync",
+            # every ban path (bad_block, bad_encoding, unsolicited) counts;
+            # node.py re-points this when it rebinds self.metrics
+            bans_counter=self.metrics.peer_bans_total)
 
     def stage_breakdown(self) -> dict:
         """The bench-facing view of the stage metrics: cumulative seconds
@@ -188,18 +203,36 @@ class BlockchainReactor(Reactor):
     # -- inbound ------------------------------------------------------------
 
     async def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
-        msg = decode_msg(msg_bytes)
+        try:
+            msg = decode_msg(msg_bytes)
+        except Exception:
+            # a garbled payload on the blocksync channel is a strike before
+            # the switch drops the link — over a Byzantine wire the
+            # scoreboard is how repeat offenders get recognized across
+            # reconnects
+            self.scoreboard.record_failure(peer.id, "bad_encoding")
+            raise
         if isinstance(msg, BlockRequest):
             block = self.store.load_block(msg.height)
             if block is not None:
-                peer.try_send(BLOCKCHAIN_CHANNEL, encode_msg(BlockResponse(block)))
+                # blocksync.bad_block (libs/faults.py): this node serves a
+                # tampered block part/commit — the fetching victim's real
+                # decode + commit-verification path must catch it and
+                # strike/ban us via its scoreboard
+                payload = faults.mutate("blocksync.bad_block",
+                                        encode_msg(BlockResponse(block)))
+                peer.try_send(BLOCKCHAIN_CHANNEL, payload)
             else:
                 peer.try_send(BLOCKCHAIN_CHANNEL, encode_msg(NoBlockResponse(msg.height)))
         elif isinstance(msg, StatusRequest):
             peer.try_send(BLOCKCHAIN_CHANNEL, encode_msg(
                 StatusResponse(self.store.height(), self.store.base())))
         elif isinstance(msg, StatusResponse):
-            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+            # a provider in backoff/ban stays out of the pool — the status
+            # broadcast would otherwise re-admit it the moment we struck it
+            if not (self.scoreboard.banned(peer.id)
+                    or self.scoreboard.in_backoff(peer.id)):
+                self.pool.set_peer_range(peer.id, msg.base, msg.height)
         elif isinstance(msg, BlockResponse):
             status = self.pool.add_block(peer.id, msg.block)
             if status == "unsolicited":
@@ -209,6 +242,7 @@ class BlockchainReactor(Reactor):
                 # honest slow peer and is silently dropped.
                 logger.warning("unsolicited block h=%d from %s",
                                msg.block.header.height, peer.id)
+                self.scoreboard.record_failure(peer.id, "unsolicited")
                 if self.switch is not None:
                     await self.switch.stop_peer_for_error(
                         peer, f"unsolicited block at {msg.block.header.height}")
@@ -552,9 +586,25 @@ class BlockchainReactor(Reactor):
                 precomputed_verdicts.reset(token)
 
     async def _punish(self, peer_ids, reason: str) -> None:
-        if self.switch is None:
-            return
-        for pid in peer_ids:
-            peer = self.switch.peers.get(pid)
-            if peer is not None:
-                await self.switch.stop_peer_for_error(peer, reason)
+        """Strike every suspected provider on the scoreboard; disconnect
+        only those the scoreboard bans (ban_threshold strikes). First
+        offenders sit out an exponential backoff instead — pool.redo
+        already dropped them, and the backoff check in StatusResponse
+        handling keeps them out until it lapses."""
+        self.metrics.sync_retries_total.inc()  # the redo behind this punish
+        for pid in set(peer_ids):
+            if self.scoreboard.banned(pid):
+                continue  # already banned (and disconnected) earlier
+            if not self.scoreboard.record_failure(pid, "bad_block"):
+                logger.info("block provider %s struck (%s); backing off",
+                            pid[:8], reason)
+                continue
+            # (the scoreboard's bans_counter already counted the ban)
+            if self.switch is not None:
+                peer = self.switch.peers.get(pid)
+                if peer is not None:
+                    await self.switch.stop_peer_for_error(peer, reason)
+        # re-discover remaining providers right away: the redo emptied the
+        # pool's view of the offenders and sync should not idle a full
+        # STATUS_UPDATE_INTERVAL before asking who else can serve
+        self._broadcast_status_request()

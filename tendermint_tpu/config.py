@@ -122,8 +122,17 @@ class StateSyncConfig:
     trust_period: float = 168 * 3600.0
     discovery_time: float = 15.0
     temp_dir: str = ""
+    # chunk fetch plane (statesync/syncer.py reads these through node.py;
+    # TMTPU_STATESYNC_CHUNK_TIMEOUT / TMTPU_STATESYNC_CHUNK_FETCHERS
+    # override per-process so chaos cells can tighten them without
+    # monkeypatching)
     chunk_request_timeout: float = 10.0
     chunk_fetchers: int = 4
+    # adversarial resilience: snapshot re-discovery rounds before giving
+    # up (then node.py falls back to fast sync from genesis), and
+    # consecutive bad chunks/snapshots before a peer is banned
+    discovery_attempts: int = 4
+    peer_ban_threshold: int = 3
 
 
 @dataclass

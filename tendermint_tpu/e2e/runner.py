@@ -115,6 +115,10 @@ class Runner:
             if nm.state_sync:
                 cfg.statesync.enable = True
                 cfg.statesync.discovery_time = 3.0
+                # adversarial nets: chunk peers may be lying — time out and
+                # strike fast so a bounded run reaches ban/fallback verdicts
+                cfg.statesync.chunk_request_timeout = 5.0
+                cfg.statesync.peer_ban_threshold = 2
             os.makedirs(os.path.join(home, CONFIG_DIR), exist_ok=True)
             os.makedirs(os.path.join(home, DATA_DIR), exist_ok=True)
             pv = FilePV.generate(cfg.priv_validator_key_file(),
@@ -349,6 +353,31 @@ class Runner:
         if "error" in doc and doc["error"]:
             raise E2EError(f"{name} {method}: {doc['error']}")
         return doc["result"]
+
+    def metric_value(self, name: str, series_prefix: str,
+                     timeout: float = 5.0) -> float:
+        """Sum a node's /metrics series whose line starts with
+        `series_prefix` (label sets summed) — how e2e assertions read ban /
+        fault / retry counters off a live node. 0.0 when the series is
+        absent or the endpoint is down."""
+        url = f"http://127.0.0.1:{self._metrics_port(name)}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                text = r.read().decode()
+        except Exception:
+            return 0.0
+        total = 0.0
+        for line in text.splitlines():
+            if not line.startswith(series_prefix) or line.startswith("#"):
+                continue
+            rest = line[len(series_prefix):]
+            if rest and rest[0] not in "{ ":
+                continue  # longer metric name sharing the prefix
+            try:
+                total += float(line.rsplit(None, 1)[-1])
+            except ValueError:
+                continue
+        return total
 
     def height(self, name: str) -> int:
         try:

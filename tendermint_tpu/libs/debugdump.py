@@ -126,6 +126,23 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
     except Exception:
         traceback.print_exc(file=sys.stderr)
 
+    # statesync progress (statesync/syncer.py progress()): a bootstrap that
+    # wedged mid-restore must be diagnosable post-mortem — which snapshot,
+    # how many chunks landed, and which peers were struck/banned
+    try:
+        import json
+
+        ss = getattr(node, "statesync_reactor", None)
+        if ss is not None:
+            syncer = getattr(ss, "syncer", None)
+            progress = (syncer.progress() if syncer is not None
+                        else getattr(ss, "last_progress", None))
+            if progress is not None:
+                with open(os.path.join(out_dir, "statesync.json"), "w") as f:
+                    json.dump(progress, f, indent=1)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
     # fleet-rollup snapshot, when a fleet scraper is running alongside this
     # node (e2e runner / bench config 4 export TMTPU_FLEET_JSON and keep the
     # file fresh): the cluster's view of the moment this node stalled

@@ -40,6 +40,22 @@ Known sites (the catalog; see README "Fault injection & chaos testing"):
 * ``db.write_batch``      — KV write batches: BufferedDB window flush and
                             SQLiteDB write_batch (libs/db.py)
 * ``net.drop``            — in-proc transport delivery (p2p/inproc.py)
+
+Content-corruption sites (the adversarial plane — ``mutate`` flips a
+deterministically-chosen bit instead of raising, so the victim's REAL
+verification path runs against the tampered bytes):
+
+* ``net.corrupt``             — payload tampering at in-proc transport
+                                delivery (p2p/inproc.py)
+* ``statesync.lying_snapshot`` — serving reactor advertises a snapshot
+                                with a bogus hash (statesync/reactor.py)
+* ``statesync.lying_chunk``   — serving reactor returns corrupted chunk
+                                bytes (statesync/reactor.py)
+* ``blocksync.bad_block``     — serving reactor returns a tampered block
+                                response (blockchain/reactor.py)
+
+All four are injected at the SERVER so the syncing/receiving node — the
+victim — exercises its production verification + peer-banning paths.
 """
 
 from __future__ import annotations
@@ -64,6 +80,11 @@ KNOWN_SITES = frozenset({
     "wal.fsync",
     "db.write_batch",
     "net.drop",
+    # content-corruption (adversarial) sites — consulted via mutate()
+    "net.corrupt",
+    "statesync.lying_snapshot",
+    "statesync.lying_chunk",
+    "blocksync.bad_block",
 })
 
 logger = logging.getLogger("tmtpu.faults")
@@ -237,6 +258,30 @@ class FaultPlane:
         if self.fire(site):
             raise (exc_factory(site) if exc_factory is not None
                    else InjectedFault(site))
+
+    def mutate(self, site: str, data: bytes) -> bytes:
+        """Content-corruption seam: return `data` with one
+        deterministically-chosen bit flipped when `site` fires, `data`
+        unchanged otherwise. The flip position comes from the site's own
+        seeded RNG, so a corruption schedule replays exactly — the i-th
+        fire of a site always tampers the same way. Empty payloads pass
+        through untouched (there is nothing to lie about)."""
+        if not self._sites or not data:
+            return data
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None or not st.evaluate():
+                return data
+            # draw under the lock from the site stream: position/bit are
+            # part of the deterministic schedule, not scheduling noise
+            pos = st.rng.randrange(len(data))
+            bit = 1 << st.rng.randrange(8)
+        m = metrics
+        if m is not None:
+            m.faults_injected_total.labels(site).inc()
+        out = bytearray(data)
+        out[pos] ^= bit
+        return bytes(out)
 
     # -- introspection (tests / tools) -------------------------------------
 

@@ -487,6 +487,59 @@ class BlocksyncMetrics:
             "blocksync", "stale_window_discards_total",
             "Prepared windows discarded because the pool or validator set "
             "moved underneath them.")
+        # -- adversarial resilience (libs/peerscore.py scoreboard) --------
+        self.peer_bans_total = c(
+            "blocksync", "peer_bans_total",
+            "Block-sync peers banned after repeated bad blocks/commits.",
+            ["reason"])
+        self.sync_retries_total = c(
+            "blocksync", "sync_retries_total",
+            "Block windows redone after a bad block from a peer.")
+
+
+class StateSyncMetrics:
+    """The snapshot-restore plane (statesync/ — reference
+    statesync/metrics.go, grown the adversarial counters a Byzantine
+    bootstrap needs: who lied, how often we retried, and whether the
+    victim banned anyone)."""
+
+    RESTORE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                       120.0, 300.0)
+
+    def __init__(self, reg: Registry):
+        g, c, h = reg.gauge, reg.counter, reg.histogram
+        self.snapshots_offered_total = c(
+            "statesync", "snapshots_offered_total",
+            "Snapshots discovered from peers and added to the pool.")
+        self.snapshots_rejected_total = c(
+            "statesync", "snapshots_rejected_total",
+            "Snapshots rejected during restore.", ["reason"])
+        self.chunks_fetched_total = c(
+            "statesync", "chunks_fetched_total",
+            "Snapshot chunks received and queued.")
+        self.chunks_discarded_total = c(
+            "statesync", "chunks_discarded_total",
+            "Chunks discarded (timeout, app retry, rejected sender).")
+        self.chunks_refetched_total = c(
+            "statesync", "chunks_refetched_total",
+            "Chunks the app explicitly asked to refetch.")
+        self.restore_duration_seconds = h(
+            "statesync", "restore_duration_seconds",
+            "Wall seconds per snapshot restore attempt.",
+            ["result"], buckets=self.RESTORE_BUCKETS)
+        self.discovery_rounds_total = c(
+            "statesync", "discovery_rounds_total",
+            "Snapshot re-discovery rounds (pool empty, peers re-asked).")
+        self.peer_bans_total = c(
+            "statesync", "peer_bans_total",
+            "Sync peers banned for serving bad snapshot data.", ["reason"])
+        self.sync_retries_total = c(
+            "statesync", "sync_retries_total",
+            "Chunk fetches retried against another peer.")
+        self.fallbacks_total = c(
+            "statesync", "fallbacks_total",
+            "State-sync attempts abandoned for the fast-sync-from-genesis "
+            "fallback (no viable snapshots / providers exhausted).")
 
 
 class NodeMetrics:
@@ -501,6 +554,7 @@ class NodeMetrics:
         self.state = StateMetrics(self.registry)
         self.crypto = CryptoMetrics(self.registry)
         self.blocksync = BlocksyncMetrics(self.registry)
+        self.statesync = StateSyncMetrics(self.registry)
         self.faults = FaultMetrics(self.registry)
         # tracer ring saturation (libs/trace.py): a bounded ring that
         # silently ate its front reads as "nothing happened early on" —

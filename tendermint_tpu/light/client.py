@@ -63,7 +63,8 @@ class LightClient:
                  store: Optional[LightStore] = None,
                  trust_level=DEFAULT_TRUST_LEVEL,
                  max_clock_drift_s: float = DEFAULT_MAX_CLOCK_DRIFT_S,
-                 skipping: bool = True):
+                 skipping: bool = True,
+                 scoreboard=None):
         validate_trust_level(trust_level)
         self.chain_id = chain_id
         self.trust_options = trust_options
@@ -74,6 +75,16 @@ class LightClient:
         self.max_clock_drift_s = max_clock_drift_s
         self.skipping = skipping
         self._initialized = False
+        # untrusted-provider bookkeeping (libs/peerscore.PeerScoreboard):
+        # a diverging witness is struck and, once banned, skipped on later
+        # cross-checks; an unavailable one backs off. The statesync state
+        # provider injects its scoreboard so witness lies land on the same
+        # peer_bans_total{reason="divergence"} series chunk lies do.
+        if scoreboard is None:
+            from ..libs.peerscore import PeerScoreboard
+
+            scoreboard = PeerScoreboard(name="light")
+        self.scoreboard = scoreboard
 
     # -- initialization (light/client.go initializeWithTrustOptions) --------
 
@@ -218,15 +229,25 @@ class LightClient:
         h = verified.signed_header.header.height
         primary_hash = verified.signed_header.header.hash()
         for w in self.witnesses:
+            if self.scoreboard.banned(w.id()):
+                continue  # a proven liar's opinion is worthless either way
             try:
                 wlb = await w.light_block(h)
             except Exception as e:
+                # transient unavailability is NOT evidence of lying: skip
+                # this round and retry at the next height — only proven
+                # divergence (below) strikes the scoreboard, so a flaky
+                # witness can never be banned into a zero-witness check
                 logger.warning("witness %s unavailable at %d: %s", w.id(), h, e)
                 continue
             whash = wlb.signed_header.header.hash()
-            if whash != primary_hash:
+            if whash == primary_hash:
+                self.scoreboard.record_success(w.id())
+            else:
                 # conflicting header: report to the witness and raise; the
                 # caller decides whether to switch primaries
+                self.scoreboard.record_failure(w.id(), "divergence",
+                                               severe=True)
                 try:
                     await w.report_evidence(
                         {"type": "light-client-attack", "height": h,

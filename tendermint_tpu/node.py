@@ -221,6 +221,10 @@ class Node:
 
         set_crypto_metrics(self.metrics.crypto)
         self.blockchain_reactor.metrics = self.metrics.blocksync
+        # the provider scoreboard counts its bans on the SHARED registry
+        # too (it was constructed against the reactor's private set)
+        self.blockchain_reactor.scoreboard.bans_counter = \
+            self.metrics.blocksync.peer_bans_total
         # robustness plane: breaker state/transitions onto the crypto set,
         # fault-plane fire counts onto their own subsystem
         from .crypto.breaker import set_breaker_metrics
@@ -285,6 +289,7 @@ class Node:
 
         self.statesync_reactor = StateSyncReactor(
             self.proxy_app.snapshot, self.proxy_app.query)
+        self.statesync_reactor.set_metrics(self.metrics.statesync)
         self._state_sync = state_sync_pending
 
         # -- transport + switch (node.go:498,567) ---------------------------
@@ -451,7 +456,10 @@ class Node:
 
     async def _run_state_sync(self) -> None:
         """(node.go:648 startStateSync) snapshot restore → bootstrap stores →
-        hand off to fast sync."""
+        hand off to fast sync. A failed restore (no viable snapshots, every
+        provider lying/banned) is NOT fatal: a fresh node can always replay
+        the chain, so it degrades to fast sync from its current (genesis)
+        state instead of wedging the process."""
         from .light.client import TrustOptions
         from .rpc.client import HTTPClient
         from .statesync import LightClientStateProvider
@@ -459,12 +467,26 @@ class Node:
         cfg = self.config.statesync
         try:
             clients = [HTTPClient(s) for s in cfg.rpc_servers]
+            # one peer-score ledger across the whole bootstrap: lying chunk
+            # servers (syncer) and diverging light-client witnesses
+            # (provider) land on the same peer_bans_total series
+            scoreboard = self.statesync_reactor.make_scoreboard(
+                ban_threshold=cfg.peer_ban_threshold)
             provider = LightClientStateProvider(
                 self.genesis.chain_id, self.genesis, clients,
                 TrustOptions(cfg.trust_period, cfg.trust_height,
-                             bytes.fromhex(cfg.trust_hash)))
+                             bytes.fromhex(cfg.trust_hash)),
+                scoreboard=scoreboard)
             state, commit = await self.statesync_reactor.sync(
-                provider, cfg.discovery_time)
+                provider, cfg.discovery_time,
+                chunk_fetchers=int(
+                    os.environ.get("TMTPU_STATESYNC_CHUNK_FETCHERS")
+                    or cfg.chunk_fetchers),
+                chunk_timeout=float(
+                    os.environ.get("TMTPU_STATESYNC_CHUNK_TIMEOUT")
+                    or cfg.chunk_request_timeout),
+                discovery_rounds=cfg.discovery_attempts,
+                scoreboard=scoreboard)
             self.state_store.bootstrap(state)
             self.block_store.save_seen_commit(state.last_block_height, commit)
             # consensus catches up via the fast-sync handoff
@@ -472,10 +494,38 @@ class Node:
             logger.info("state sync complete at height %d; entering fast sync",
                         state.last_block_height)
             await self.blockchain_reactor.switch_to_fast_sync(state)
+        except asyncio.CancelledError:
+            raise
         except Exception as e:
-            logger.critical("state sync failed: %s", e)
-            self.fatal_error = e
-            self.fatal_event.set()
+            # replaying from genesis is only sound against a PRISTINE app:
+            # a restore that already landed (then failed the trusted-hash
+            # check, or whose provider died afterwards) left the app at the
+            # snapshot height, and executing block 1 onto it would diverge
+            from .abci import types as abci_types
+
+            try:
+                info = self.proxy_app.query.info(abci_types.RequestInfo())
+                pristine = info.last_block_height == 0
+            except Exception:
+                pristine = False
+            if not pristine:
+                logger.critical(
+                    "state sync failed (%s) after the app was mutated; "
+                    "cannot fall back to fast sync", e)
+                self.fatal_error = e
+                self.fatal_event.set()
+                return
+            logger.critical(
+                "state sync failed (%s); falling back to fast sync from "
+                "height %d", e, self.blockchain_reactor.state.last_block_height)
+            self.metrics.statesync.fallbacks_total.inc()
+            try:
+                await self.blockchain_reactor.switch_to_fast_sync(
+                    self.blockchain_reactor.state)
+            except Exception as e2:  # the fallback itself dying IS fatal
+                logger.critical("fast-sync fallback failed: %s", e2)
+                self.fatal_error = e2
+                self.fatal_event.set()
 
     async def stop(self) -> None:
         if not self._started:

@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, List, Optional
 
 from ...libs import protowire as pw
+from ...libs.faults import faults
 from ..base import ChannelDescriptor
 
 logger = logging.getLogger("tmtpu.p2p.mconn")
@@ -153,6 +154,8 @@ class MConnection:
         ch = self.channels.get(channel_id)
         if ch is None or self._stopped:
             return False
+        if faults.armed("net.corrupt"):
+            msg = faults.mutate("net.corrupt", msg)
         try:
             await asyncio.wait_for(ch.queue.put(msg), timeout)
         except asyncio.TimeoutError:
@@ -164,6 +167,11 @@ class MConnection:
         ch = self.channels.get(channel_id)
         if ch is None or self._stopped:
             return False
+        # net.corrupt over TCP: tamper BEFORE framing/encryption, so the
+        # wire stays valid and the remote's decode/signature/merkle checks
+        # meet the flipped bits (same semantics as the in-proc site)
+        if faults.armed("net.corrupt"):
+            msg = faults.mutate("net.corrupt", msg)
         try:
             ch.queue.put_nowait(msg)
         except asyncio.QueueFull:

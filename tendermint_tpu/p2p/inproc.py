@@ -104,12 +104,16 @@ class InProcPeer(Peer):
         pol = self.policy
         if pol is None and not faults.enabled:
             return self._deliver(channel_id, msg)
-        # generic env-armed site (TMTPU_FAULTS=net.drop@p): drops ride the
-        # same path as a policy drop. The lock-free armed() probe keeps
-        # chaos runs arming only storage/device sites off fire()'s lock on
-        # this per-message path
+        # generic env-armed sites (TMTPU_FAULTS=net.drop@p / net.corrupt@p):
+        # drops ride the same path as a policy drop; corruption tampers the
+        # payload IN FLIGHT (a Byzantine wire) so the receiver's decode /
+        # signature / merkle checks run against the flipped bits. The
+        # lock-free armed() probes keep chaos runs arming only
+        # storage/device sites off fire()'s lock on this per-message path
         if faults.armed("net.drop") and faults.fire("net.drop"):
             return True
+        if faults.armed("net.corrupt"):
+            msg = faults.mutate("net.corrupt", msg)
         if pol is None:
             return self._deliver(channel_id, msg)
         delays = pol.plan()
@@ -206,6 +210,34 @@ class InProcNetwork:
             await sw_a.stop_peer_gracefully(pa)
         if pb is not None:
             await sw_b.stop_peer_gracefully(pb)
+
+    def connected(self, id_a: str, id_b: str) -> bool:
+        """Both switches hold a live peer object for the other side."""
+        return (id_b in self.switches[id_a].peers
+                and id_a in self.switches[id_b].peers)
+
+    async def reconnect_missing(self) -> int:
+        """Re-establish any severed pair — the in-proc analog of persistent-
+        peer redial. A corrupted message makes the receiver drop the link
+        (stop_peer_for_error); without this, adversarial chaos runs bleed
+        connectivity until the net partitions itself. Existing LinkPolicy
+        objects (and their RNG streams) carry over to the fresh peers so a
+        seeded chaos schedule survives reconnects. Returns pairs rewired."""
+        count = 0
+        pairs = {tuple(sorted(k)) for k in self.links}
+        for id_a, id_b in sorted(pairs):
+            if self.connected(id_a, id_b):
+                continue
+            pol_ab = self.links.get((id_a, id_b))
+            pol_ba = self.links.get((id_b, id_a))
+            pol_ab = pol_ab.policy if pol_ab is not None else None
+            pol_ba = pol_ba.policy if pol_ba is not None else None
+            await self.disconnect(id_a, id_b)  # clear any half-open side
+            await self.connect(id_a, id_b)
+            self.links[(id_a, id_b)].policy = pol_ab
+            self.links[(id_b, id_a)].policy = pol_ba
+            count += 1
+        return count
 
     # -- chaos controls ------------------------------------------------------
 

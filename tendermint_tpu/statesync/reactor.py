@@ -1,15 +1,26 @@
 """State-sync reactor — channels Snapshot=0x60, Chunk=0x61
 (reference statesync/reactor.go:22,31): serves local app snapshots to
 syncing peers and feeds inbound snapshots/chunks to the Syncer.
+
+The SERVING side carries two adversarial fault sites
+(``statesync.lying_snapshot`` / ``statesync.lying_chunk``, libs/faults.py):
+when armed, this node becomes the Byzantine peer — advertising snapshots
+with tampered hashes or returning corrupted chunk bytes — so a chaos run's
+VICTIMS exercise their real verification + peer-banning paths against it.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
+import zlib
 from typing import List, Optional
 
 from ..abci import types as abci
+from ..libs.faults import faults
+from ..libs.metrics import Registry, StateSyncMetrics
+from ..libs.peerscore import PeerScoreboard
 from ..p2p import CHUNK_CHANNEL, SNAPSHOT_CHANNEL
 from ..p2p.base import ChannelDescriptor, Peer, Reactor
 from .msgs import (
@@ -20,7 +31,7 @@ from .msgs import (
     decode_msg,
     encode_msg,
 )
-from .syncer import Syncer
+from .syncer import CHUNK_FETCHERS, CHUNK_REQUEST_TIMEOUT, DISCOVERY_ROUNDS, Syncer
 
 logger = logging.getLogger("tmtpu.statesync")
 
@@ -34,6 +45,15 @@ class StateSyncReactor(Reactor):
         self.app_snapshot = proxy_snapshot
         self.app_query = proxy_query
         self.syncer: Optional[Syncer] = None
+        # node.py rebinds this onto the shared registry; standalone
+        # reactors (tests) keep a private set
+        self.metrics = StateSyncMetrics(Registry())
+        # survives the syncer teardown so debugdump can explain a restore
+        # that already failed/finished
+        self.last_progress: Optional[dict] = None
+
+    def set_metrics(self, m) -> None:
+        self.metrics = m
 
     def get_channels(self) -> List[ChannelDescriptor]:
         return [
@@ -58,8 +78,12 @@ class StateSyncReactor(Reactor):
         msg = decode_msg(msg_bytes)
         if isinstance(msg, SnapshotsRequest):
             for s in self._local_snapshots():
+                # statesync.lying_snapshot: advertise a bogus hash — the
+                # victim restores the real chunks, fails its trusted-app-
+                # hash check, and must blame/ban the advertiser
+                hash_ = faults.mutate("statesync.lying_snapshot", s.hash)
                 peer.try_send(SNAPSHOT_CHANNEL, encode_msg(
-                    SnapshotsResponse(s.height, s.format, s.chunks, s.hash,
+                    SnapshotsResponse(s.height, s.format, s.chunks, hash_,
                                       s.metadata)))
         elif isinstance(msg, SnapshotsResponse):
             if self.syncer is not None:
@@ -70,8 +94,12 @@ class StateSyncReactor(Reactor):
             resp = self.app_snapshot.load_snapshot_chunk(
                 abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index))
             missing = not resp.chunk
+            # statesync.lying_chunk: serve corrupted chunk bytes — the
+            # victim's app detects the tamper (per-chunk hash or whole-blob
+            # check) and its syncer strikes/bans this sender
+            chunk = faults.mutate("statesync.lying_chunk", resp.chunk)
             peer.try_send(CHUNK_CHANNEL, encode_msg(ChunkResponse(
-                msg.height, msg.format, msg.index, resp.chunk, missing)))
+                msg.height, msg.format, msg.index, chunk, missing)))
         elif isinstance(msg, ChunkResponse):
             if self.syncer is not None:
                 self.syncer.add_chunk(msg, peer.id)
@@ -87,9 +115,29 @@ class StateSyncReactor(Reactor):
 
     # -- sync orchestration (reactor.go Sync / node.go:648 startStateSync) ---
 
-    async def sync(self, state_provider, discovery_time: float = 5.0):
+    def make_scoreboard(self, ban_threshold: int = 3,
+                        seed: Optional[int] = None) -> PeerScoreboard:
+        """A scoreboard wired to this reactor's metric set. node.py builds
+        it up front so the light-client state provider (witness
+        cross-checks) and the syncer (chunk blame) share one ledger."""
+        if seed is None:
+            seed = faults.seed
+        return PeerScoreboard(
+            ban_threshold=ban_threshold, seed=seed, name="statesync",
+            bans_counter=self.metrics.peer_bans_total,
+            retries_counter=self.metrics.sync_retries_total)
+
+    async def sync(self, state_provider, discovery_time: float = 5.0,
+                   chunk_fetchers: int = CHUNK_FETCHERS,
+                   chunk_timeout: float = CHUNK_REQUEST_TIMEOUT,
+                   discovery_rounds: int = DISCOVERY_ROUNDS,
+                   ban_threshold: int = 3,
+                   seed: Optional[int] = None,
+                   scoreboard: Optional[PeerScoreboard] = None):
         """Run a snapshot restore; -> (state, commit). The caller bootstraps
-        the stores and hands off to fast sync / consensus."""
+        the stores and hands off to fast sync / consensus. All randomness
+        (peer rotation, backoff jitter) derives from `seed` (default: the
+        fault-plane seed) so chaos runs replay."""
         async def request_chunk(peer_id, height, fmt, idx):
             peer = self.switch.peers.get(peer_id) if self.switch else None
             if peer is None:
@@ -97,11 +145,26 @@ class StateSyncReactor(Reactor):
             peer.try_send(CHUNK_CHANNEL, encode_msg(
                 ChunkRequest(height, fmt, idx)))
 
-        self.syncer = Syncer(self.app_snapshot, self.app_query, state_provider,
-                             request_chunk)
-        if self.switch is not None:
-            self.switch.broadcast(SNAPSHOT_CHANNEL, encode_msg(SnapshotsRequest()))
+        def rediscover():
+            if self.switch is not None:
+                self.switch.broadcast(SNAPSHOT_CHANNEL,
+                                      encode_msg(SnapshotsRequest()))
+
+        if seed is None:
+            seed = faults.seed
+        m = self.metrics
+        if scoreboard is None:
+            scoreboard = self.make_scoreboard(ban_threshold, seed)
+        self.syncer = Syncer(
+            self.app_snapshot, self.app_query, state_provider, request_chunk,
+            chunk_fetchers=chunk_fetchers, chunk_timeout=chunk_timeout,
+            rng=random.Random(zlib.crc32(f"{seed}|statesync.fetch".encode())),
+            scoreboard=scoreboard, metrics=m)
+        rediscover()
         try:
-            return await self.syncer.sync_any(discovery_time)
+            return await self.syncer.sync_any(
+                discovery_time, rediscover=rediscover,
+                discovery_rounds=discovery_rounds)
         finally:
+            self.last_progress = self.syncer.progress()
             self.syncer = None

@@ -33,15 +33,17 @@ class LightClientStateProvider(StateProvider):
     """
 
     def __init__(self, chain_id: str, genesis, rpc_clients: List,
-                 trust_options: TrustOptions):
+                 trust_options: TrustOptions, scoreboard=None):
         if len(rpc_clients) < 2:
             raise ValueError("state sync needs >= 2 rpc servers "
                              "(primary + witness)")
         self.chain_id = chain_id
         self.genesis = genesis
         providers = [HTTPProvider(chain_id, c) for c in rpc_clients]
+        # share the syncer's scoreboard (node.py passes it) so a diverging
+        # witness and a lying chunk server count on the same ban series
         self.client = LightClient(chain_id, trust_options, providers[0],
-                                  providers[1:])
+                                  providers[1:], scoreboard=scoreboard)
 
     async def app_hash(self, height: int) -> bytes:
         """AppHash for `height` lives in header `height+1` (stateprovider.go)."""

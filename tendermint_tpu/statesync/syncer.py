@@ -3,23 +3,46 @@ SyncAny): pick a snapshot advertised by peers, OfferSnapshot to the app,
 fetch chunks with parallel fetchers, ApplySnapshotChunk with
 retry/refetch/reject semantics, and verify the restored app hash against a
 light-client-obtained header.
+
+Hardened for UNTRUSTED peers (the adversarial setting of arXiv 2410.03347:
+a bootstrapping node must survive Byzantine data providers, not just
+silent ones):
+
+* every chunk fetch routes through a :class:`PeerScoreboard` — bad chunks
+  (app reject/refetch, timeouts) put the sender in exponential backoff and
+  ban it after K strikes; snapshot-level verification failures blame every
+  advertiser of that snapshot;
+* peer selection is DETERMINISTIC: the sorted advertiser list is shuffled
+  once per peer-set by the reactor-injected seeded RNG, then rotated per
+  retry — a chaos run replays its fetch schedule exactly, and repeated
+  retries of one chunk walk every advertiser instead of re-rolling dice;
+* snapshot discovery is a LOOP, not a single fixed sleep: an empty pool
+  re-asks the net (``rediscover`` callback) up to ``discovery_rounds``
+  times before giving up with ErrNoSnapshots — the caller (node.py) then
+  falls back to fast sync from genesis instead of dying.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..abci import types as abci
+from ..libs.peerscore import PeerScoreboard
 from .chunks import ChunkQueue
 from .stateprovider import StateProvider
 
 logger = logging.getLogger("tmtpu.statesync")
 
+# defaults; config.statesync.chunk_fetchers / chunk_request_timeout (with
+# TMTPU_STATESYNC_CHUNK_FETCHERS / TMTPU_STATESYNC_CHUNK_TIMEOUT env
+# overrides) are the operator-facing knobs — node.py passes them through
 CHUNK_FETCHERS = 4
 CHUNK_REQUEST_TIMEOUT = 10.0
+DISCOVERY_ROUNDS = 4
 
 
 class SyncError(Exception):
@@ -31,7 +54,20 @@ class ErrNoSnapshots(SyncError):
 
 
 class ErrSnapshotRejected(SyncError):
-    pass
+    """``blame_advertisers=True`` marks CONTENT failures — the restored
+    data contradicted the advertised hash or the trusted app hash — where
+    every advertiser of the key provably vouched for bad data. App-policy
+    rejections (offer refused, unsupported format) and exhausted-peer
+    aborts carry no such proof and must not ban anyone."""
+
+    def __init__(self, msg: str, blame_advertisers: bool = False,
+                 retriable: bool = False):
+        super().__init__(msg)
+        self.blame_advertisers = blame_advertisers
+        # retriable: the snapshot CONTENT was never disproven (e.g. every
+        # advertiser vanished/was banned mid-restore) — drop it from the
+        # current pool but let a later honest advertisement re-add it
+        self.retriable = retriable
 
 
 class ErrRetrySnapshot(SyncError):
@@ -72,11 +108,19 @@ class SnapshotPool:
         cands = [k for k in self.snapshots if k not in self.rejected]
         if not cands:
             return None
-        return max(cands, key=lambda k: (k.height, k.format))
+        # hash is the deterministic tie-break: two same-height snapshots
+        # (one honest, one a lie) are tried in a stable order across runs
+        return max(cands, key=lambda k: (k.height, k.format, k.hash))
 
     def reject(self, key: SnapshotKey) -> None:
         self.rejected.add(key)
         self.snapshots.pop(key, None)
+
+    def forget(self, key: SnapshotKey) -> None:
+        """Drop a key WITHOUT blacklisting it — a fresh advertisement (a
+        new honest peer) may legitimately re-add it."""
+        self.snapshots.pop(key, None)
+        self.metadata.pop(key, None)
 
     def reject_format(self, fmt: int) -> None:
         for k in list(self.snapshots):
@@ -90,7 +134,9 @@ class SnapshotPool:
                 del self.snapshots[k]
 
     def peers_of(self, key: SnapshotKey) -> List[str]:
-        return list(self.snapshots.get(key, ()))
+        # sorted: set iteration order depends on PYTHONHASHSEED — a
+        # replayable fetch schedule needs a stable peer order
+        return sorted(self.snapshots.get(key, ()))
 
 
 class Syncer:
@@ -98,7 +144,10 @@ class Syncer:
 
     def __init__(self, proxy_snapshot, proxy_query, state_provider: StateProvider,
                  request_chunk, chunk_fetchers: int = CHUNK_FETCHERS,
-                 chunk_timeout: float = CHUNK_REQUEST_TIMEOUT):
+                 chunk_timeout: float = CHUNK_REQUEST_TIMEOUT,
+                 rng: Optional[random.Random] = None,
+                 scoreboard: Optional[PeerScoreboard] = None,
+                 metrics=None):
         self.app_snapshot = proxy_snapshot
         self.app_query = proxy_query
         self.state_provider = state_provider
@@ -106,47 +155,142 @@ class Syncer:
         self.pool = SnapshotPool()
         self.chunk_fetchers = chunk_fetchers
         self.chunk_timeout = chunk_timeout
+        # injected by the reactor (seeded from the fault-plane seed) so
+        # fault runs replay; standalone harnesses get a fixed default
+        self.rng = rng if rng is not None else random.Random(0)
+        self.scoreboard = scoreboard if scoreboard is not None \
+            else PeerScoreboard(name="statesync")
+        self.metrics = metrics              # libs.metrics.StateSyncMetrics
         self.chunks: Optional[ChunkQueue] = None
         self._current: Optional[SnapshotKey] = None
+        self._applied = 0
+        self._discovery_round = 0
+        # per-peer-set deterministic rotation order + per-chunk attempts
+        self._order_cache: Tuple[Tuple[str, ...], List[str]] = ((), [])
+        self._attempts: Dict[int, int] = {}
+
+    # -- inbound (reactor feeds these) ---------------------------------------
 
     def add_snapshot(self, peer_id: str, resp) -> bool:
-        return self.pool.add(peer_id, resp.height, resp.format, resp.chunks,
-                             resp.hash, resp.metadata)
+        new = self.pool.add(peer_id, resp.height, resp.format, resp.chunks,
+                            resp.hash, resp.metadata)
+        if new and self.metrics is not None:
+            self.metrics.snapshots_offered_total.inc()
+        return new
 
     def add_chunk(self, resp, sender: str) -> None:
         cur = self._current
         if (self.chunks is None or cur is None
                 or resp.height != cur.height or resp.format != cur.format):
-            return
+            return  # late or mismatched response from a previous attempt
         if resp.missing:
             self.chunks.discard(resp.index)
             return
-        self.chunks.add(resp.index, resp.chunk, sender)
+        if self.chunks.add(resp.index, resp.chunk, sender) \
+                and self.metrics is not None:
+            self.metrics.chunks_fetched_total.inc()
 
-    async def sync_any(self, discovery_time: float = 5.0):
+    # -- progress (debugdump / watchdog post-mortems) ------------------------
+
+    def progress(self) -> dict:
+        """JSON-safe snapshot of where the restore stands — a wedged
+        bootstrap must be diagnosable from the bundle alone."""
+        cur = self._current
+        return {
+            "snapshot": None if cur is None else {
+                "height": cur.height, "format": cur.format,
+                "chunks": cur.chunks, "hash": cur.hash.hex(),
+            },
+            "chunks_applied": self._applied,
+            "chunks_total": 0 if cur is None else cur.chunks,
+            "discovery_round": self._discovery_round,
+            "pool_snapshots": len(self.pool.snapshots),
+            "pool_rejected": len(self.pool.rejected),
+            "peer_scores": self.scoreboard.snapshot(),
+        }
+
+    # -- orchestration -------------------------------------------------------
+
+    async def sync_any(self, discovery_time: float = 5.0,
+                       rediscover: Optional[Callable[[], None]] = None,
+                       discovery_rounds: int = DISCOVERY_ROUNDS):
         """(syncer.go:145 SyncAny) -> (state, commit) for the restored height.
-        Tries snapshots best-first until one restores or none remain."""
+        Tries snapshots best-first; an empty pool re-asks the net up to
+        `discovery_rounds` times before raising ErrNoSnapshots."""
+        rounds_left = max(1, discovery_rounds)
         await asyncio.sleep(discovery_time)
         while True:
             key = self.pool.best()
             if key is None:
-                raise ErrNoSnapshots("no viable snapshots remain")
+                rounds_left -= 1
+                if rounds_left <= 0:
+                    raise ErrNoSnapshots("no viable snapshots remain")
+                self._discovery_round += 1
+                if self.metrics is not None:
+                    self.metrics.discovery_rounds_total.inc()
+                logger.info("snapshot pool empty; re-discovering "
+                            "(%d rounds left)", rounds_left)
+                if rediscover is not None:
+                    rediscover()
+                await asyncio.sleep(discovery_time)
+                continue
+            advertisers = self.pool.peers_of(key)
             try:
                 return await self._sync(key)
-            except ErrSnapshotRejected:
-                logger.info("snapshot %d/%d rejected; trying next",
-                            key.height, key.format)
-                self.pool.reject(key)
+            except ErrSnapshotRejected as e:
+                logger.info("snapshot %d/%d rejected (%s); trying next",
+                            key.height, key.format, e)
+                if e.blame_advertisers:
+                    # content-level rejection: every peer that advertised
+                    # this snapshot vouched for bad data (per-chunk lies
+                    # were already attributed to their senders upstream)
+                    self._blame(advertisers, "bad_snapshot", severe=True)
+                    self._count_rejected("content")
+                    self.pool.reject(key)
+                elif e.retriable:
+                    # content never disproven (advertisers gone/banned):
+                    # drop it for now, but a re-discovered honest peer may
+                    # re-advertise the same key later
+                    self._count_rejected("no_peers")
+                    self.pool.forget(key)
+                else:
+                    self._count_rejected("policy")
+                    self.pool.reject(key)
             except ErrRetrySnapshot:
                 logger.info("retrying snapshot %d/%d", key.height, key.format)
+                self._count_rejected("retry")
             except ErrAbort:
                 raise
+
+    def _blame(self, peer_ids, reason: str, severe: bool = False) -> None:
+        for pid in peer_ids:
+            if self.scoreboard.record_failure(pid, reason, severe=severe):
+                logger.warning("statesync peer %s banned (%s)",
+                               pid[:8], reason)
+
+    def _count_rejected(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.snapshots_rejected_total.labels(reason).inc()
 
     async def _sync(self, key: SnapshotKey):
         """(syncer.go Sync) one snapshot attempt."""
         self._current = key
         self.chunks = ChunkQueue(key.chunks)
+        self._applied = 0
+        self._attempts = {}
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        result = "rejected"
+        try:
+            out = await self._sync_inner(key)
+            result = "restored"
+            return out
+        finally:
+            if self.metrics is not None:
+                self.metrics.restore_duration_seconds.labels(result).observe(
+                    loop.time() - t0)
 
+    async def _sync_inner(self, key: SnapshotKey):
         # fetch trusted app hash FIRST (stateprovider → light client): the
         # offer to the app carries it
         app_hash = await self.state_provider.app_hash(key.height)
@@ -172,28 +316,47 @@ class Syncer:
             applied = 0
             while applied < key.chunks:
                 if not self.chunks.has(applied):
+                    if not self._eligible_peers(key):
+                        # every advertiser is banned or gone: this snapshot
+                        # can never complete — reject instead of wedging
+                        raise ErrSnapshotRejected(
+                            "no eligible peers left for snapshot",
+                            retriable=True)
                     await self.chunks.wait_change(0.25)
                     continue
                 chunk = self.chunks.get(applied)
+                sender = self.chunks.sender(applied)
                 r = self.app_snapshot.apply_snapshot_chunk(
                     abci.RequestApplySnapshotChunk(
-                        index=applied, chunk=chunk,
-                        sender=self.chunks.sender(applied)))
+                        index=applied, chunk=chunk, sender=sender))
                 if r.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT:
                     applied += 1
+                    self._applied = applied
+                    if sender:
+                        self.scoreboard.record_success(sender)
                 elif r.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY:
-                    self.chunks.discard(applied)
+                    self._discard(applied)
                 elif r.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY_SNAPSHOT:
                     raise ErrRetrySnapshot("app requested snapshot retry")
                 elif r.result == abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT:
-                    raise ErrSnapshotRejected("app rejected snapshot")
+                    # mid-restore data rejection (e.g. whole-blob hash vs
+                    # the advertised hash): the advertised key was bad
+                    raise ErrSnapshotRejected("app rejected snapshot",
+                                              blame_advertisers=True)
                 elif r.result == abci.APPLY_SNAPSHOT_CHUNK_ABORT:
                     raise ErrAbort("app aborted during chunk apply")
                 for idx in r.refetch_chunks:
-                    self.chunks.discard(idx)
-                for sender in r.reject_senders:
-                    self.chunks.discard_sender(sender)
-                    self.pool.remove_peer(sender)
+                    self._discard(idx)
+                    if self.metrics is not None:
+                        self.metrics.chunks_refetched_total.inc()
+                for bad_sender in r.reject_senders:
+                    # the app PROVED this sender served garbage (it
+                    # verified the chunk against offered metadata) — ban it
+                    # and drop everything it contributed
+                    self._blame([bad_sender], "rejected_chunk", severe=True)
+                    self.chunks.discard_sender(bad_sender)
+                    if self.scoreboard.banned(bad_sender):
+                        self.pool.remove_peer(bad_sender)
         finally:
             for f in fetchers:
                 f.cancel()
@@ -203,21 +366,51 @@ class Syncer:
         if info.last_block_app_hash != app_hash:
             raise ErrSnapshotRejected(
                 f"restored app hash {info.last_block_app_hash.hex()} != trusted "
-                f"{app_hash.hex()}")
+                f"{app_hash.hex()}", blame_advertisers=True)
         if info.last_block_height != key.height:
             raise ErrSnapshotRejected(
-                f"restored app height {info.last_block_height} != {key.height}")
+                f"restored app height {info.last_block_height} != {key.height}",
+                blame_advertisers=True)
 
         state = await self.state_provider.state(key.height)
         commit = await self.state_provider.commit(key.height)
         logger.info("snapshot restored at height %d", key.height)
         return state, commit
 
-    async def _fetch_loop(self, key: SnapshotKey) -> None:
-        """One fetcher: allocate an index, ask a random peer, await arrival
-        or re-allocate on timeout."""
-        import random
+    def _discard(self, idx: int) -> None:
+        self.chunks.discard(idx)
+        if self.metrics is not None:
+            self.metrics.chunks_discarded_total.inc()
 
+    # -- peer selection (deterministic, score-aware) -------------------------
+
+    def _eligible_peers(self, key: SnapshotKey) -> List[str]:
+        """Advertisers we may ask for a chunk right now, in the seeded
+        rotation order. Backing-off peers are re-admitted as a last resort
+        (better a slow peer than a wedged restore); banned peers never."""
+        peers = self.pool.peers_of(key)
+        order = self._rotation_order(peers)
+        out = self.scoreboard.eligible(order)
+        if not out:
+            out = self.scoreboard.eligible(order, allow_backoff=True)
+        return out
+
+    def _rotation_order(self, peers: List[str]) -> List[str]:
+        """One seeded shuffle per distinct peer set: deterministic for a
+        given (seed, peer set), stable across retries so idx+attempt
+        rotation walks every advertiser."""
+        sig = tuple(peers)
+        cached_sig, cached = self._order_cache
+        if sig == cached_sig:
+            return cached
+        order = list(peers)
+        self.rng.shuffle(order)
+        self._order_cache = (sig, order)
+        return order
+
+    async def _fetch_loop(self, key: SnapshotKey) -> None:
+        """One fetcher: allocate an index, ask the next peer in the seeded
+        rotation, await arrival or re-allocate on timeout."""
         while True:
             idx = self.chunks.allocate()
             if idx is None:
@@ -226,20 +419,28 @@ class Syncer:
                 # fetcher; cancellation (finally block in _sync) ends us
                 await asyncio.sleep(0.1)
                 continue
-            peers = self.pool.peers_of(key)
+            peers = self._eligible_peers(key)
             if not peers:
                 await asyncio.sleep(0.5)
-                self.chunks.discard(idx)
+                self._discard(idx)
                 continue
-            peer_id = random.choice(peers)
+            attempt = self._attempts.get(idx, 0)
+            self._attempts[idx] = attempt + 1
+            peer_id = peers[(idx + attempt) % len(peers)]
+            if attempt > 0:
+                self.scoreboard.note_retry()
             try:
                 await self.request_chunk(peer_id, key.height, key.format, idx)
             except Exception:
-                self.chunks.discard(idx)
+                self._discard(idx)
                 continue
             deadline = asyncio.get_running_loop().time() + self.chunk_timeout
             while not self.chunks.has(idx):
                 if asyncio.get_running_loop().time() > deadline:
-                    self.chunks.discard(idx)  # re-allocate elsewhere
+                    # a peer that never answers is indistinguishable from a
+                    # malicious one at this layer: strike + backoff, and
+                    # re-allocate the chunk elsewhere
+                    self.scoreboard.record_failure(peer_id, "timeout")
+                    self._discard(idx)
                     break
                 await self.chunks.wait_change(0.25)

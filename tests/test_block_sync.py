@@ -342,3 +342,58 @@ def test_window_precompute_covers_both_planes(one_val_genesis, monkeypatch):
     assert pre_sigs > 0, dict(crypto_batch.stats)
     conns.stop()
     conns2.stop()
+
+
+# -- adversarial: tampered block responses (blocksync.bad_block site) ---------
+
+def test_fast_sync_survives_tampered_block_response(one_val_genesis, monkeypatch):
+    """One served BlockResponse gets a bit flipped (the blocksync.bad_block
+    serving-side fault site). The victim's verification path must catch it,
+    strike the provider on the scoreboard (backoff, not yet ban at one
+    offense), redo the window, and finish the sync from the other source —
+    never wedge, never apply a tampered block."""
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    pv, genesis = one_val_genesis
+    from dataclasses import replace
+
+    from tendermint_tpu.libs.faults import faults
+
+    async def run():
+        quiet = replace(test_consensus_config(), create_empty_blocks=False)
+        # build_chain is deterministic (MockPV + BFT time), so two builds
+        # give two independent sources serving byte-identical blocks
+        chain_a = build_chain(30, pv, genesis)
+        chain_b = build_chain(30, pv, genesis)
+        assert chain_a[0].last_block_id == chain_b[0].last_block_id
+        src_a = SyncNode("src_a", genesis, pv=pv, fast_sync=False,
+                         chain=chain_a, config=quiet)
+        src_b = SyncNode("src_b", genesis, pv=None, fast_sync=False,
+                         chain=chain_b, config=quiet)
+        fresh = SyncNode("fresh", genesis, pv=None, fast_sync=True,
+                         config=quiet)
+        net = InProcNetwork()
+        for nd in (src_a, src_b, fresh):
+            net.add_switch(nd.switch)
+        await src_a.start()
+        await src_b.start()
+        # the very next served block response is tampered: exactly one
+        # injection, so the test is deterministic for any seed
+        faults.configure("blocksync.bad_block*1", seed=6)
+        await fresh.start()
+        await net.connect("src_a", "fresh")
+        await net.connect("src_b", "fresh")
+        try:
+            await asyncio.wait_for(fresh.bc_reactor.synced.wait(), timeout=90)
+            assert fresh.state_store.load().last_block_height >= 29
+        finally:
+            for nd in (fresh, src_b, src_a):
+                await nd.stop()
+        assert faults.fires("blocksync.bad_block") == 1
+        scores = fresh.bc_reactor.scoreboard.snapshot()
+        assert sum(s["total_failures"] for s in scores.values()) >= 1, scores
+        # one offense is backoff territory, not a ban
+        assert fresh.bc_reactor.scoreboard.ban_count() == 0, scores
+        # the synced chain is the honest one
+        assert fresh.state_store.load().last_block_id == chain_a[0].last_block_id
+
+    asyncio.run(run())

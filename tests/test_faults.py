@@ -215,3 +215,59 @@ def test_armed_is_lock_free_membership():
     assert not fp.armed("wal.fsync") or fp.fire("wal.fsync") is False
     fp.reset()
     assert not fp.armed("wal.fsync")
+
+
+# -- content corruption (mutate) ---------------------------------------------
+
+def test_mutate_disabled_passthrough():
+    fp = FaultPlane()
+    data = b"payload-bytes"
+    assert fp.mutate("net.corrupt", data) is data
+
+
+def test_mutate_flips_exactly_one_bit_when_armed():
+    fp = FaultPlane().configure("net.corrupt", seed=3)
+    data = bytes(range(64))
+    out = fp.mutate("net.corrupt", data)
+    assert out != data and len(out) == len(data)
+    diffs = [(a ^ b) for a, b in zip(data, out) if a != b]
+    assert len(diffs) == 1 and bin(diffs[0]).count("1") == 1
+    assert fp.fires("net.corrupt") == 1
+
+
+def test_mutate_schedule_replays_exactly():
+    def run(seed):
+        fp = FaultPlane().configure("net.corrupt@0.5*8", seed=seed)
+        return [fp.mutate("net.corrupt", bytes(32)) for _ in range(40)]
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)
+    # the cap bounds the corrupted count deterministically
+    fp = FaultPlane().configure("net.corrupt@0.5*8", seed=11)
+    corrupted = sum(fp.mutate("net.corrupt", bytes(32)) != bytes(32)
+                    for _ in range(100))
+    assert corrupted == 8 == fp.fires("net.corrupt")
+
+
+def test_mutate_empty_payload_untouched():
+    fp = FaultPlane().configure("net.corrupt")
+    assert fp.mutate("net.corrupt", b"") == b""
+    assert fp.fires("net.corrupt") == 0  # nothing to lie about, no fire
+
+
+def test_mutate_unarmed_site_does_not_draw():
+    """A mutate on site A must not perturb site B's stream (per-site RNGs)."""
+    fp = FaultPlane().configure("a@0.5,b@0.5", seed=5)
+    seq_b = [fp.fire("b") for _ in range(20)]
+    fp2 = FaultPlane().configure("a@0.5,b@0.5", seed=5)
+    for _ in range(30):
+        fp2.mutate("a", b"xx")
+    assert [fp2.fire("b") for _ in range(20)] == seq_b
+
+
+def test_adversarial_sites_in_catalog():
+    from tendermint_tpu.libs.faults import KNOWN_SITES
+
+    for site in ("net.corrupt", "statesync.lying_snapshot",
+                 "statesync.lying_chunk", "blocksync.bad_block"):
+        assert site in KNOWN_SITES

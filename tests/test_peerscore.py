@@ -1,0 +1,134 @@
+"""Untrusted-peer scoreboard (libs/peerscore.py): ban-after-K strikes,
+severe (proven-lie) instant bans, exponential backoff with seeded jitter,
+success-resets, eligibility filtering, and metric accounting — the shared
+substrate under statesync chunk blame, blocksync _punish, and light-client
+witness cross-checks.
+"""
+
+import pytest
+
+from tendermint_tpu.libs.metrics import Registry
+from tendermint_tpu.libs.peerscore import PeerScoreboard
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_ban_after_k_consecutive_failures():
+    sb = PeerScoreboard(ban_threshold=3)
+    assert not sb.record_failure("p", "timeout")
+    assert not sb.record_failure("p", "timeout")
+    assert sb.record_failure("p", "timeout")
+    assert sb.banned("p")
+    # further failures keep reporting banned, no state explosion
+    assert sb.record_failure("p", "timeout")
+    assert sb.snapshot()["p"]["ban_reason"] == "timeout"
+
+
+def test_severe_failure_bans_instantly():
+    sb = PeerScoreboard(ban_threshold=5)
+    assert sb.record_failure("liar", "rejected_chunk", severe=True)
+    assert sb.banned("liar")
+    assert sb.snapshot()["liar"]["ban_reason"] == "rejected_chunk"
+
+
+def test_success_resets_consecutive_count():
+    sb = PeerScoreboard(ban_threshold=2)
+    sb.record_failure("p", "timeout")
+    sb.record_success("p")
+    assert not sb.record_failure("p", "timeout")  # back to strike 1
+    assert not sb.banned("p")
+    sb.record_failure("p", "timeout")
+    assert sb.banned("p")
+    # success cannot un-ban
+    sb.record_success("p")
+    assert sb.banned("p")
+
+
+def test_exponential_backoff_with_clock():
+    clock = FakeClock()
+    sb = PeerScoreboard(ban_threshold=10, backoff_base_s=1.0, jitter=0.0,
+                        clock=clock)
+    sb.record_failure("p")
+    assert sb.in_backoff("p")
+    assert sb.eligible(["p"]) == []
+    assert sb.eligible(["p"], allow_backoff=True) == ["p"]
+    clock.t = 1.01
+    assert not sb.in_backoff("p")
+    assert sb.eligible(["p"]) == ["p"]
+    # second consecutive failure doubles the wait
+    sb.record_failure("p")
+    clock.t += 1.5
+    assert sb.in_backoff("p")
+    clock.t += 0.6
+    assert not sb.in_backoff("p")
+
+
+def test_backoff_capped_at_max():
+    clock = FakeClock()
+    sb = PeerScoreboard(ban_threshold=100, backoff_base_s=1.0,
+                        backoff_max_s=4.0, jitter=0.0, clock=clock)
+    for _ in range(10):
+        sb.record_failure("p")
+    assert sb.snapshot()["p"]["backoff_remaining_s"] <= 4.0
+
+
+def test_jitter_is_seeded_deterministic():
+    def schedule(seed):
+        clock = FakeClock()
+        sb = PeerScoreboard(ban_threshold=50, seed=seed, clock=clock)
+        out = []
+        for _ in range(8):
+            sb.record_failure("p")
+            out.append(sb.snapshot()["p"]["backoff_remaining_s"])
+        return out
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)
+
+
+def test_eligible_preserves_order_and_skips_banned():
+    sb = PeerScoreboard(ban_threshold=1)
+    sb.record_failure("b", "x")  # banned (threshold 1)
+    assert sb.eligible(["a", "b", "c"]) == ["a", "c"]
+    assert sb.eligible(["a", "b", "c"], allow_backoff=True) == ["a", "c"]
+    assert sb.ban_count() == 1
+
+
+def test_metrics_counters():
+    reg = Registry("t")
+    bans = reg.counter("sync", "peer_bans_total", "bans", ["reason"])
+    retries = reg.counter("sync", "sync_retries_total", "retries")
+    sb = PeerScoreboard(ban_threshold=2, bans_counter=bans,
+                        retries_counter=retries)
+    sb.record_failure("p", "bad_chunk")
+    assert bans.value("bad_chunk") == 0
+    sb.record_failure("p", "bad_chunk")
+    assert bans.value("bad_chunk") == 1
+    # already banned: no double count
+    sb.record_failure("p", "bad_chunk")
+    assert bans.value("bad_chunk") == 1
+    sb.note_retry()
+    sb.note_retry()
+    assert retries.value() == 2
+
+
+def test_forget_and_reset():
+    sb = PeerScoreboard(ban_threshold=1)
+    sb.record_failure("p", "x")
+    assert sb.banned("p")
+    sb.forget("p")
+    assert not sb.banned("p")
+    sb.record_failure("q", "x")
+    sb.reset()
+    assert sb.snapshot() == {}
+
+
+def test_threshold_must_be_positive():
+    with pytest.raises(ValueError):
+        PeerScoreboard(ban_threshold=0)

@@ -121,3 +121,78 @@ def test_state_sync_bootstrap(tmp_path):
             await node_a.stop()
 
     asyncio.run(run())
+
+
+def test_state_sync_falls_back_to_fast_sync_when_no_snapshots(tmp_path):
+    """ErrNoSnapshots is survivable: a fresh node whose statesync finds no
+    viable snapshot (the serving app never produced one) must NOT set
+    fatal_error — it logs, counts the fallback, and fast-syncs the chain
+    from genesis instead (ISSUE 8 acceptance)."""
+    async def run():
+        pv = FilePV.generate("", "")
+        genesis = GenesisDoc(chain_id=CHAIN,
+                             genesis_time_ns=1_700_000_000_000_000_000,
+                             validators=[GenesisValidator(pv.get_pub_key(), 10)],
+                             consensus_params=ConsensusParams(
+                                 block=BlockParams(time_iota_ms=1)))
+
+        # interval=0: the serving app NEVER snapshots, so discovery is
+        # guaranteed to come up empty no matter how long B asks
+        serve_app = SnapshotKVStoreApplication(interval=0)
+        node_a = _mk(tmp_path, "a", genesis, pv, b"\xa7" * 32, serve_app)
+        await node_a.start()
+        try:
+            from tendermint_tpu.rpc.client import HTTPClient
+
+            a_rpc = f"http://127.0.0.1:{node_a.rpc_server.bound_port}"
+            client = HTTPClient(a_rpc)
+            await client.broadcast_tx_commit(b"fka=va")
+            for _ in range(600):
+                st = await client.status()
+                if int(st["sync_info"]["latest_block_height"]) >= 5:
+                    break
+                await asyncio.sleep(0.05)
+
+            from tendermint_tpu.light.provider import HTTPProvider
+
+            lb1 = await HTTPProvider(CHAIN, client).light_block(1)
+            trust_hash = lb1.signed_header.header.hash().hex()
+
+            pv_b = FilePV.generate("", "")
+            fresh_app = SnapshotKVStoreApplication(interval=0)
+            node_b = _mk(
+                tmp_path, "b", genesis, pv_b, b"\xb8" * 32, fresh_app,
+                statesync_cfg={
+                    "enable": True,
+                    "rpc_servers": [a_rpc, a_rpc],
+                    "trust_height": 1,
+                    "trust_hash": trust_hash,
+                    "trust_period": 10 * 365 * 24 * 3600.0,
+                    "discovery_time": 0.2,
+                    "discovery_attempts": 2,
+                },
+                persistent_peers=f"{node_a.node_key.id}@127.0.0.1:"
+                                 f"{node_a.listen_addr.port}")
+            await node_b.start()
+            try:
+                for _ in range(600):
+                    assert not node_b.fatal_event.is_set(), \
+                        f"fallback must not be fatal: {node_b.fatal_error}"
+                    if (node_b.blockchain_reactor.synced.is_set()
+                            and node_b.consensus_state.state.last_block_height >= 5):
+                        break
+                    await asyncio.sleep(0.05)
+                assert not node_b.fatal_event.is_set(), node_b.fatal_error
+                assert node_b.consensus_state.state.last_block_height >= 5
+                # it REPLAYED the chain (fast sync from genesis): block 1 is
+                # in the store, unlike a snapshot bootstrap
+                assert node_b.block_store.load_block(1) is not None
+                assert fresh_app.state.get("fka") == "va"
+                assert node_b.metrics.statesync.fallbacks_total.value() == 1
+            finally:
+                await node_b.stop()
+            await client.close()
+        finally:
+            await node_a.stop()
+
+    asyncio.run(run())

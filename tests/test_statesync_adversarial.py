@@ -1,0 +1,483 @@
+"""Untrusted-peer statesync (ISSUE 8): lying chunk servers get banned and
+the restore completes from honest peers; lying snapshot advertisers get
+blamed when the trusted-hash check fails; the discovery loop re-asks the
+net instead of sleeping once and giving up; peer selection is seeded and
+deterministic; and the SnapshotPool/ChunkQueue edge cases around
+remove_peer / reject_format / late chunks / retry_all behave.
+
+The harness is the in-proc Byzantine rig: a real SnapshotKVStoreApplication
+pair (server with a multi-chunk snapshot + fresh restore target), a stub
+state provider pinning the trusted app hash, and per-peer request_chunk
+closures standing in for the p2p reactors.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.kvstore import SnapshotKVStoreApplication
+from tendermint_tpu.libs.faults import faults
+from tendermint_tpu.libs.metrics import Registry, StateSyncMetrics
+from tendermint_tpu.libs.peerscore import PeerScoreboard
+from tendermint_tpu.statesync.chunks import ChunkQueue
+from tendermint_tpu.statesync.msgs import ChunkResponse, SnapshotsResponse
+from tendermint_tpu.statesync.stateprovider import StateProvider
+from tendermint_tpu.statesync.syncer import (
+    ErrNoSnapshots,
+    SnapshotKey,
+    SnapshotPool,
+    Syncer,
+)
+
+CHUNK_PAYLOAD = "v" * 150
+
+
+def make_server(n_keys=40):
+    """Server app with one multi-chunk snapshot at height 1."""
+    app = SnapshotKVStoreApplication(interval=1)
+    for i in range(n_keys):
+        app.deliver_tx(abci.RequestDeliverTx(
+            tx=f"key{i:03d}={CHUNK_PAYLOAD}".encode()))
+    app.commit()
+    return app
+
+
+class StubProvider(StateProvider):
+    def __init__(self, app_hash):
+        self._hash = app_hash
+
+    async def app_hash(self, height):
+        return self._hash
+
+    async def commit(self, height):
+        return "commit"
+
+    async def state(self, height):
+        return "state"
+
+
+def make_syncer(server, client, request_chunk, *, seed=0, ban_threshold=2,
+                metrics=None, chunk_timeout=2.0):
+    return Syncer(client, client, StubProvider(server.app_hash),
+                  request_chunk, chunk_timeout=chunk_timeout,
+                  rng=random.Random(seed),
+                  scoreboard=PeerScoreboard(ban_threshold=ban_threshold,
+                                            seed=seed),
+                  metrics=metrics)
+
+
+def serve_chunk(server, syncer, peer_id, height, fmt, idx,
+                tamper=None, drop=False):
+    """Answer one ChunkRequest the way the reactor would."""
+    if drop:
+        return
+    resp = server.load_snapshot_chunk(
+        abci.RequestLoadSnapshotChunk(height, fmt, idx))
+    chunk = resp.chunk
+    if tamper is not None:
+        chunk = tamper(chunk)
+    syncer.add_chunk(ChunkResponse(height, fmt, idx, chunk, not resp.chunk),
+                     peer_id)
+
+
+def advertise_all(server, syncer, peer_ids):
+    snaps = server.list_snapshots(abci.RequestListSnapshots()).snapshots
+    for s in snaps:
+        for pid in peer_ids:
+            syncer.add_snapshot(pid, s)
+    return snaps
+
+
+# -- the Byzantine restore ----------------------------------------------------
+
+def _lying_chunk_restore(seed):
+    server = make_server()
+    client = SnapshotKVStoreApplication(interval=1)
+    metrics = StateSyncMetrics(Registry("t"))
+    asked = []
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            asked.append((peer_id, idx))
+            serve_chunk(server, syncer, peer_id, height, fmt, idx,
+                        tamper=(lambda c: b"\x00" + c[1:])
+                        if peer_id == "liar" else None)
+
+        syncer = make_syncer(server, client, request_chunk, seed=seed,
+                             metrics=metrics)
+        syncer.scoreboard.bans_counter = metrics.peer_bans_total
+        syncer.scoreboard.retries_counter = metrics.sync_retries_total
+        advertise_all(server, syncer, ["honest-a", "honest-b", "liar"])
+        state, commit = await syncer.sync_any(discovery_time=0.01)
+        assert (state, commit) == ("state", "commit")
+        return syncer
+
+    syncer = asyncio.run(run())
+    return syncer, metrics, asked
+
+
+def test_lying_chunk_server_banned_and_restore_completes():
+    syncer, metrics, asked = _lying_chunk_restore(seed=4)
+    assert syncer.scoreboard.banned("liar")
+    assert not syncer.scoreboard.banned("honest-a")
+    assert not syncer.scoreboard.banned("honest-b")
+    # the rotation really spread fetches across every advertiser
+    assert {p for p, _ in asked} == {"honest-a", "honest-b", "liar"}
+    # the ban is on the metric the acceptance criteria reads
+    assert metrics.peer_bans_total.value("rejected_chunk") >= 1
+    assert metrics.chunks_refetched_total.value() >= 1
+    assert metrics.restore_duration_seconds.count_value("restored") == 1
+
+
+def test_lying_chunk_schedule_replays_exactly():
+    """Same seed -> identical fetch schedule and identical blame; a chaos
+    run with TMTPU_FAULTS_SEED fixed reproduces its injection schedule."""
+    s1, _, asked1 = _lying_chunk_restore(seed=9)
+    s2, _, asked2 = _lying_chunk_restore(seed=9)
+    assert asked1 == asked2
+    assert s1.scoreboard.snapshot().keys() == s2.scoreboard.snapshot().keys()
+    s3, _, asked3 = _lying_chunk_restore(seed=10)
+    assert asked3 != asked1  # a different seed shuffles differently
+
+
+def test_lying_snapshot_advertiser_blamed_then_honest_restore():
+    server = make_server()
+    client = SnapshotKVStoreApplication(interval=1)
+    metrics = StateSyncMetrics(Registry("t"))
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            serve_chunk(server, syncer, peer_id, height, fmt, idx)
+
+        syncer = make_syncer(server, client, request_chunk, seed=1,
+                             ban_threshold=3, metrics=metrics)
+        syncer.scoreboard.bans_counter = metrics.peer_bans_total
+        snaps = advertise_all(server, syncer, [])
+        # the liar is first on the scene, advertising a tampered hash
+        for s in snaps:
+            syncer.add_snapshot("liar", abci.Snapshot(
+                s.height, s.format, s.chunks,
+                bytes([s.hash[0] ^ 1]) + s.hash[1:], s.metadata))
+
+        def rediscover():
+            for s in snaps:
+                for pid in ("honest-a", "honest-b"):
+                    syncer.add_snapshot(pid, s)
+
+        state, commit = await syncer.sync_any(discovery_time=0.02,
+                                              rediscover=rediscover)
+        assert (state, commit) == ("state", "commit")
+        return syncer
+
+    syncer = asyncio.run(run())
+    # advertising a provably-bad snapshot is a severe strike: banned
+    assert syncer.scoreboard.banned("liar")
+    assert metrics.peer_bans_total.value("bad_snapshot") == 1
+    assert metrics.snapshots_rejected_total.value("content") == 1
+    assert metrics.discovery_rounds_total.value() >= 1
+    assert client.state == server.state
+
+
+def test_empty_pool_rediscovers_then_gives_up():
+    server = make_server()
+    client = SnapshotKVStoreApplication(interval=1)
+    rounds = []
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            raise AssertionError("no chunks should ever be requested")
+
+        syncer = make_syncer(server, client, request_chunk, seed=1)
+        with pytest.raises(ErrNoSnapshots):
+            await syncer.sync_any(discovery_time=0.01,
+                                  rediscover=lambda: rounds.append(1),
+                                  discovery_rounds=3)
+
+    asyncio.run(run())
+    assert len(rounds) == 2  # re-asked between rounds, then gave up
+
+
+def test_unresponsive_peer_times_out_strikes_and_restore_completes():
+    server = make_server(n_keys=20)
+    client = SnapshotKVStoreApplication(interval=1)
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            serve_chunk(server, syncer, peer_id, height, fmt, idx,
+                        drop=(peer_id == "mute"))
+
+        syncer = make_syncer(server, client, request_chunk, seed=2,
+                             ban_threshold=2, chunk_timeout=0.3)
+        advertise_all(server, syncer, ["honest-a", "mute"])
+        state, commit = await syncer.sync_any(discovery_time=0.01)
+        assert (state, commit) == ("state", "commit")
+        return syncer
+
+    syncer = asyncio.run(run())
+    scores = syncer.scoreboard.snapshot()
+    assert scores["mute"]["total_failures"] >= 1
+    assert client.state == server.state
+
+
+def test_all_advertisers_banned_rejects_snapshot():
+    """A snapshot whose every advertiser is banned mid-restore must be
+    rejected (then ErrNoSnapshots), never wedge the apply loop."""
+    server = make_server(n_keys=20)
+    client = SnapshotKVStoreApplication(interval=1)
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            serve_chunk(server, syncer, peer_id, height, fmt, idx,
+                        tamper=lambda c: b"\xff" + c[1:])
+
+        syncer = make_syncer(server, client, request_chunk, seed=3,
+                             ban_threshold=1)
+        advertise_all(server, syncer, ["liar-a", "liar-b"])
+        with pytest.raises(ErrNoSnapshots):
+            await syncer.sync_any(discovery_time=0.01, discovery_rounds=1)
+        return syncer
+
+    syncer = asyncio.run(run())
+    assert syncer.scoreboard.ban_count() >= 1
+
+
+# -- deterministic peer rotation ----------------------------------------------
+
+def test_peers_of_is_sorted_and_rotation_is_seeded():
+    pool = SnapshotPool()
+    key_args = (5, 1, 4, b"h" * 32)
+    for pid in ("zz", "aa", "mm"):
+        pool.add(pid, *key_args, b"")
+    key = SnapshotKey(*key_args)
+    assert pool.peers_of(key) == ["aa", "mm", "zz"]
+
+    def order(seed):
+        s = Syncer(None, None, StubProvider(b""), None,
+                   rng=random.Random(seed))
+        return s._rotation_order(["aa", "mm", "zz"])
+
+    assert order(1) == order(1)
+    assert sorted(order(1)) == ["aa", "mm", "zz"]
+
+
+# -- SnapshotPool / ChunkQueue edge cases (the satellite checklist) -----------
+
+def test_pool_remove_peer_drops_snapshot_with_last_peer():
+    pool = SnapshotPool()
+    pool.add("only", 5, 1, 3, b"x" * 32, b"meta")
+    pool.add("p1", 6, 1, 3, b"y" * 32, b"meta")
+    pool.add("p2", 6, 1, 3, b"y" * 32, b"meta")
+    pool.remove_peer("only")
+    assert pool.best() == SnapshotKey(6, 1, 3, b"y" * 32)
+    pool.remove_peer("p1")
+    assert pool.best() == SnapshotKey(6, 1, 3, b"y" * 32)  # p2 still vouches
+    pool.remove_peer("p2")
+    assert pool.best() is None
+
+
+def test_pool_reject_format_sweeps_and_blocks_readd():
+    pool = SnapshotPool()
+    pool.add("p", 4, 1, 2, b"a" * 32, b"")
+    pool.add("p", 5, 1, 2, b"b" * 32, b"")
+    pool.add("p", 5, 2, 2, b"c" * 32, b"")
+    pool.reject_format(1)
+    assert pool.best() == SnapshotKey(5, 2, 2, b"c" * 32)
+    # a rejected key cannot be re-advertised back in
+    assert not pool.add("p2", 5, 1, 2, b"b" * 32, b"")
+    assert pool.best() == SnapshotKey(5, 2, 2, b"c" * 32)
+
+
+def test_pool_best_tiebreak_is_deterministic():
+    pool = SnapshotPool()
+    pool.add("a", 5, 1, 2, b"\x01" * 32, b"")
+    pool.add("b", 5, 1, 2, b"\x02" * 32, b"")
+    assert pool.best() == SnapshotKey(5, 1, 2, b"\x02" * 32)
+
+
+def test_add_chunk_wrong_height_or_format_ignored():
+    server = make_server(n_keys=4)
+    client = SnapshotKVStoreApplication(interval=1)
+    syncer = make_syncer(server, client, None, seed=1)
+    snaps = advertise_all(server, syncer, ["p"])
+    key = syncer.pool.best()
+    syncer._current = key
+    syncer.chunks = ChunkQueue(key.chunks)
+    # wrong height / wrong format / no restore in flight are all dropped
+    syncer.add_chunk(ChunkResponse(key.height + 1, key.format, 0, b"x", False),
+                     "p")
+    syncer.add_chunk(ChunkResponse(key.height, key.format + 9, 0, b"x", False),
+                     "p")
+    assert not syncer.chunks.has(0)
+    # matching response lands (and counts)
+    syncer.add_chunk(ChunkResponse(key.height, key.format, 0, b"x", False), "p")
+    assert syncer.chunks.has(0)
+    # a late duplicate for the same index is ignored, sender unchanged
+    syncer.add_chunk(ChunkResponse(key.height, key.format, 0, b"y", False), "q")
+    assert syncer.chunks.get(0) == b"x" and syncer.chunks.sender(0) == "p"
+    # out-of-range index ignored
+    syncer.add_chunk(ChunkResponse(key.height, key.format, key.chunks + 3,
+                                   b"x", False), "p")
+    # missing=True discards (so it gets re-fetched elsewhere)
+    syncer.add_chunk(ChunkResponse(key.height, key.format, 0, b"", True), "p")
+    assert not syncer.chunks.has(0)
+    # after the restore tears down, nothing lands
+    syncer.chunks = None
+    syncer._current = None
+    syncer.add_chunk(ChunkResponse(key.height, key.format, 1, b"x", False), "p")
+
+
+def test_chunk_queue_retry_all_after_app_retry_snapshot():
+    q = ChunkQueue(4)
+    for i in range(4):
+        assert q.allocate() == i
+        q.add(i, b"c%d" % i, f"peer{i}")
+    assert q.complete()
+    q.retry_all()  # the RETRY_SNAPSHOT path re-fetches everything
+    assert not q.complete()
+    assert all(not q.has(i) for i in range(4))
+    assert q.sender(0) == ""
+    # indexes are allocatable again, in order
+    assert [q.allocate() for _ in range(4)] == [0, 1, 2, 3]
+    assert q.allocate() is None
+
+
+def test_chunk_queue_discard_sender_only_hits_their_chunks():
+    q = ChunkQueue(3)
+    for i in range(3):
+        q.allocate()
+    q.add(0, b"a", "alice")
+    q.add(1, b"b", "bob")
+    q.add(2, b"c", "alice")
+    q.discard_sender("alice")
+    assert not q.has(0) and q.has(1) and not q.has(2)
+    assert q.sender(1) == "bob"
+
+
+# -- the app-side per-chunk verification (what makes blame attributable) ------
+
+def test_kvstore_metadata_carries_chunk_hashes_and_rejects_tampered_chunk():
+    import hashlib
+    import json
+
+    server = make_server()
+    snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+    hashes = json.loads(snap.metadata.decode())["chunk_hashes"]
+    assert len(hashes) == snap.chunks > 1
+    chunk0 = server.load_snapshot_chunk(
+        abci.RequestLoadSnapshotChunk(snap.height, snap.format, 0)).chunk
+    assert hashlib.sha256(chunk0).hexdigest() == hashes[0]
+
+    client = SnapshotKVStoreApplication(interval=1)
+    offer = client.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=snap, app_hash=server.app_hash))
+    assert offer.result == abci.OFFER_SNAPSHOT_ACCEPT
+    # a tampered chunk is named-and-shamed, not applied
+    r = client.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+        index=0, chunk=b"\x00" + chunk0[1:], sender="liar"))
+    assert r.result == abci.APPLY_SNAPSHOT_CHUNK_RETRY
+    assert r.refetch_chunks == [0]
+    assert r.reject_senders == ["liar"]
+    # the honest chunk then applies
+    r = client.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+        index=0, chunk=chunk0, sender="honest"))
+    assert r.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT
+
+
+def test_kvstore_snapshot_without_metadata_still_restores():
+    """Backward compat: snapshots with empty/garbled metadata skip the
+    per-chunk check and rely on the whole-blob hash, as before."""
+    server = make_server(n_keys=6)
+    snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+    bare = abci.Snapshot(snap.height, snap.format, snap.chunks, snap.hash,
+                         b"not-json")
+    client = SnapshotKVStoreApplication(interval=1)
+    assert client.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=bare, app_hash=server.app_hash)).result \
+        == abci.OFFER_SNAPSHOT_ACCEPT
+    for i in range(snap.chunks):
+        chunk = server.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(snap.height, snap.format, i)).chunk
+        r = client.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+            index=i, chunk=chunk, sender="p"))
+        assert r.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT
+    assert client.state == server.state
+
+
+# -- serving reactor fault seams ----------------------------------------------
+
+class FakePeer:
+    def __init__(self, pid="peer-1"):
+        self.id = pid
+        self.sent = []
+
+    def try_send(self, channel_id, payload):
+        self.sent.append((channel_id, payload))
+        return True
+
+
+def test_reactor_serves_lies_only_when_armed():
+    from tendermint_tpu.statesync.msgs import ChunkRequest, decode_msg, encode_msg
+    from tendermint_tpu.statesync.reactor import StateSyncReactor
+
+    server = make_server(n_keys=8)
+    reactor = StateSyncReactor(server, server)
+    snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+
+    async def ask(msg):
+        peer = FakePeer()
+        await reactor.receive(0x61, peer, encode_msg(msg))
+        return [decode_msg(p) for _, p in peer.sent]
+
+    async def run():
+        honest = (await ask(ChunkRequest(snap.height, snap.format, 0)))[0]
+        assert not honest.missing
+        true_chunk = server.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+            snap.height, snap.format, 0)).chunk
+        assert honest.chunk == true_chunk
+
+        faults.configure("statesync.lying_chunk", seed=5)
+        lied = (await ask(ChunkRequest(snap.height, snap.format, 0)))[0]
+        assert lied.chunk != true_chunk
+        assert len(lied.chunk) == len(true_chunk)
+        assert faults.fires("statesync.lying_chunk") == 1
+        faults.reset()
+
+        # snapshot advert: honest then tampered-hash
+        from tendermint_tpu.statesync.msgs import SnapshotsRequest
+
+        peer = FakePeer()
+        await reactor.receive(0x60, peer, encode_msg(SnapshotsRequest()))
+        honest_ad = decode_msg(peer.sent[0][1])
+        assert honest_ad.hash == snap.hash
+        faults.configure("statesync.lying_snapshot", seed=5)
+        peer2 = FakePeer()
+        await reactor.receive(0x60, peer2, encode_msg(SnapshotsRequest()))
+        lying_ad = decode_msg(peer2.sent[0][1])
+        assert lying_ad.hash != snap.hash
+        assert lying_ad.height == snap.height
+        faults.reset()
+
+    asyncio.run(run())
+
+
+def test_syncer_progress_snapshot_shape():
+    server = make_server(n_keys=4)
+    client = SnapshotKVStoreApplication(interval=1)
+    syncer = make_syncer(server, client, None, seed=1)
+    p0 = syncer.progress()
+    assert p0["snapshot"] is None and p0["chunks_applied"] == 0
+    advertise_all(server, syncer, ["p"])
+    key = syncer.pool.best()
+    syncer._current = key
+    syncer.chunks = ChunkQueue(key.chunks)
+    syncer._applied = 1
+    syncer.scoreboard.record_failure("q", "timeout")
+    p = syncer.progress()
+    assert p["snapshot"]["height"] == key.height
+    assert p["chunks_applied"] == 1 and p["chunks_total"] == key.chunks
+    assert p["peer_scores"]["q"]["total_failures"] == 1
+    import json
+
+    json.dumps(p)  # debugdump bundles it verbatim

@@ -15,9 +15,27 @@ deterministic seed, asserting the survival property that site promises:
 * net.drop            — 4-node in-proc net commits +3 heights under seeded
   10% loss with identical block hashes (the slow cell, ~30-60s)
 
+Adversarial (content-corruption) cells — the Byzantine chaos suite:
+
+* net.corrupt              — 4-node net stays live and hash-identical while
+  a capped 10% of in-flight payloads get a bit flipped (receivers drop the
+  corrupting link; persistent-peer-style reconnects re-heal it); injection
+  count replays exactly for a seed
+* statesync.lying_chunk    — a restore served by honest peers + one liar
+  completes anyway: per-chunk verification strikes the liar, bans it after
+  K bad chunks, refetches from honest peers
+* statesync.lying_snapshot — a snapshot advertised with a bogus hash is
+  restored, fails the trusted-app-hash check, its advertiser is struck,
+  and re-discovery finds the honest snapshot
+* blocksync.bad_block      — a fresh node fast-syncs a chain although its
+  providers serve a capped number of tampered block responses (redo +
+  scoreboard backoff/ban)
+* combo.maverick_corrupt   — double-prevoting validator AND corrupt links
+  at once; honest nodes agree (the slow combo cell)
+
     python tools/chaos_matrix.py                     # full matrix
-    python tools/chaos_matrix.py --quick             # skip the net cell
-    python tools/chaos_matrix.py --sites wal.fsync --seeds 1,2
+    python tools/chaos_matrix.py --quick             # skip the net cells
+    python tools/chaos_matrix.py --sites statesync.lying_chunk --seeds 1,2
     python tools/chaos_matrix.py --self-test         # CI guard, seconds
 
 Stdlib-only at the top level (argparse/subprocess/time): repo imports
@@ -39,13 +57,19 @@ if REPO not in sys.path:  # `python tools/chaos_matrix.py` puts tools/ first
     sys.path.insert(0, REPO)
 
 DEFAULT_SEEDS = (1, 2, 3)
-#: cell name -> (callable name, slow?)
+#: cell name -> slow?
 SITES = {
     "device.batch_verify": False,
     "device.vote_flush": False,
     "wal.fsync": False,
     "db.write_batch": False,
     "net.drop": True,
+    # adversarial cells (content corruption / Byzantine peers)
+    "net.corrupt": True,
+    "statesync.lying_chunk": False,
+    "statesync.lying_snapshot": False,
+    "blocksync.bad_block": True,
+    "combo.maverick_corrupt": True,
 }
 
 
@@ -227,12 +251,319 @@ def cell_net_drop(seed: int) -> None:
     asyncio.run(run())
 
 
+async def _live_net_under(site_spec: str, seed: int, extra_heights: int = 3,
+                          mavericks=None, post_wait=None):
+    """Shared adversarial-net driver: 4 in-proc validators, the given fault
+    spec armed mid-run, a persistent-peer-style reconnect loop (corrupted
+    payloads make receivers drop links), +N heights, identical hashes.
+    ``post_wait`` (async) runs while the net is still live — e.g. to wait
+    for an injection cap to be reached."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_consensus_net import make_net, wait_all_height
+
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.p2p import InProcNetwork
+
+    nodes = make_net(4)
+    for idx, height_map in (mavericks or {}).items():
+        nodes[idx].cs.misbehaviors = dict(height_map)
+    net = InProcNetwork()
+    for nd in nodes:
+        net.add_switch(nd.switch)
+    for nd in nodes:
+        await nd.start()
+    await net.connect_all()
+
+    async def rewire():
+        while True:
+            await asyncio.sleep(0.3)
+            await net.reconnect_missing()
+
+    rewire_task = asyncio.create_task(rewire())
+    try:
+        await wait_all_height(nodes, 2, timeout=60)
+        faults.configure(site_spec, seed=seed)
+        h0 = min(nd.cs.state.last_block_height for nd in nodes)
+        await wait_all_height(nodes, h0 + extra_heights, timeout=180)
+        if post_wait is not None:
+            await post_wait()
+        # disarm BEFORE teardown so shutdown traffic doesn't tail-fire
+        faults.reset()
+    finally:
+        rewire_task.cancel()
+        for nd in nodes:
+            await nd.stop()
+    common = min(nd.cs.state.last_block_height for nd in nodes) - 1
+    hashes = {nd.block_store.load_block_meta(common).header.hash()
+              for nd in nodes}
+    assert len(hashes) == 1, "divergent block hashes under corruption"
+
+
+def cell_net_corrupt(seed: int) -> None:
+    import asyncio
+
+    from tendermint_tpu.libs.faults import faults
+
+    cap = 30
+    observed = []
+
+    async def until_cap():
+        # the armed net keeps committing (empty blocks) so traffic keeps
+        # evaluating the site; the cap WILL be reached — wait for it so the
+        # injection count is exactly reproducible across seeds/runs
+        deadline = asyncio.get_running_loop().time() + 60
+        while faults.fires("net.corrupt") < cap:
+            if asyncio.get_running_loop().time() > deadline:
+                break
+            await asyncio.sleep(0.25)
+        observed.append(faults.fires("net.corrupt"))
+
+    asyncio.run(_live_net_under(f"net.corrupt@0.1*{cap}", seed,
+                                post_wait=until_cap))
+    assert observed and observed[0] == cap, \
+        f"expected {cap} injections, saw {observed}"
+
+
+def cell_combo_maverick_corrupt(seed: int) -> None:
+    """The Byzantine combo: a double-prevoting validator AND corrupt links
+    at once — honest nodes must keep committing and agree."""
+    import asyncio
+
+    from tendermint_tpu.libs.faults import faults
+
+    observed = []
+
+    async def snap_fires():
+        observed.append(faults.fires("net.corrupt"))
+
+    asyncio.run(_live_net_under("net.corrupt@0.1*10", seed,
+                                extra_heights=4,
+                                mavericks={3: {3: "double-prevote"}},
+                                post_wait=snap_fires))
+    assert observed and observed[0] > 0, "site never fired"
+
+
+def _statesync_harness():
+    """Server app with a multi-chunk snapshot + fresh client app + stub
+    state provider — the in-proc Byzantine statesync rig."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.example.kvstore import SnapshotKVStoreApplication
+    from tendermint_tpu.statesync.stateprovider import StateProvider
+
+    server = SnapshotKVStoreApplication(interval=1)
+    for i in range(40):
+        server.deliver_tx(abci.RequestDeliverTx(
+            tx=f"key{i:03d}={'v' * 150}".encode()))
+    server.commit()  # height 1: snapshot with ~7 chunks
+    client = SnapshotKVStoreApplication(interval=1)
+
+    class StubProvider(StateProvider):
+        async def app_hash(self, height):
+            return server.app_hash
+
+        async def commit(self, height):
+            return "commit"
+
+        async def state(self, height):
+            return "state"
+
+    return server, client, StubProvider()
+
+
+def _run_lying_chunk_restore(seed: int):
+    """One full restore against 2 honest peers + 1 always-lying chunk
+    server; returns (syncer, injected fire count)."""
+    import asyncio
+    import random as _random
+
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.libs.peerscore import PeerScoreboard
+    from tendermint_tpu.statesync.msgs import ChunkResponse
+    from tendermint_tpu.statesync.syncer import Syncer
+
+    server, client, provider = _statesync_harness()
+    faults.configure("statesync.lying_chunk", seed=seed)
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            resp = server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(height, fmt, idx))
+            chunk = resp.chunk
+            if peer_id == "liar":  # the serving reactor's fault seam
+                chunk = faults.mutate("statesync.lying_chunk", chunk)
+            syncer.add_chunk(
+                ChunkResponse(height, fmt, idx, chunk, not resp.chunk),
+                peer_id)
+
+        syncer = Syncer(client, client, provider, request_chunk,
+                        chunk_timeout=2.0,
+                        rng=_random.Random(seed),
+                        scoreboard=PeerScoreboard(ban_threshold=2, seed=seed))
+        snaps = server.list_snapshots(abci.RequestListSnapshots()).snapshots
+        for s in snaps:
+            for pid in ("honest-a", "honest-b", "liar"):
+                syncer.add_snapshot(pid, s)
+        state, commit = await syncer.sync_any(discovery_time=0.01)
+        assert (state, commit) == ("state", "commit")
+        return syncer
+
+    syncer = asyncio.run(run())
+    assert client.state == server.state, "restored state diverged"
+    return syncer, faults.fires("statesync.lying_chunk")
+
+
+def cell_statesync_lying_chunk(seed: int) -> None:
+    from tendermint_tpu.libs.faults import faults
+
+    syncer, fires1 = _run_lying_chunk_restore(seed)
+    assert fires1 > 0, "liar was never asked for a chunk"
+    assert syncer.scoreboard.banned("liar"), \
+        f"liar not banned: {syncer.scoreboard.snapshot()}"
+    assert not syncer.scoreboard.banned("honest-a")
+    assert not syncer.scoreboard.banned("honest-b")
+    # replayability: same seed, fresh plane -> identical injection count
+    faults.reset()
+    syncer2, fires2 = _run_lying_chunk_restore(seed)
+    assert fires2 == fires1, f"injection count diverged: {fires1} != {fires2}"
+    assert syncer2.scoreboard.banned("liar")
+
+
+def cell_statesync_lying_snapshot(seed: int) -> None:
+    import asyncio
+
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.libs.peerscore import PeerScoreboard
+    from tendermint_tpu.statesync.msgs import ChunkResponse
+    from tendermint_tpu.statesync.syncer import Syncer
+
+    server, client, provider = _statesync_harness()
+    faults.configure("statesync.lying_snapshot*1", seed=seed)
+
+    async def run():
+        async def request_chunk(peer_id, height, fmt, idx):
+            resp = server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(height, fmt, idx))
+            syncer.add_chunk(
+                ChunkResponse(height, fmt, idx, resp.chunk, not resp.chunk),
+                peer_id)
+
+        syncer = Syncer(client, client, provider, request_chunk,
+                        chunk_timeout=2.0,
+                        scoreboard=PeerScoreboard(ban_threshold=1, seed=seed))
+        snaps = server.list_snapshots(abci.RequestListSnapshots()).snapshots
+
+        def rediscover():
+            # honest advertisers answer the re-ask after the lie collapses
+            for s in snaps:
+                for pid in ("honest-a", "honest-b"):
+                    syncer.add_snapshot(pid, s)
+
+        # initially only the liar has been heard from — with a bogus hash
+        # (the serving reactor's statesync.lying_snapshot seam); tampered
+        # COPIES so the honest re-advertisements above stay honest
+        for s in snaps:
+            syncer.add_snapshot("liar", abci.Snapshot(
+                s.height, s.format, s.chunks,
+                faults.mutate("statesync.lying_snapshot", s.hash),
+                s.metadata))
+        state, commit = await syncer.sync_any(discovery_time=0.05,
+                                              rediscover=rediscover)
+        assert (state, commit) == ("state", "commit")
+        return syncer
+
+    syncer = asyncio.run(run())
+    assert client.state == server.state
+    assert syncer.scoreboard.banned("liar"), \
+        f"lying advertiser not banned: {syncer.scoreboard.snapshot()}"
+    assert faults.fires("statesync.lying_snapshot") == 1
+
+
+def cell_blocksync_bad_block(seed: int) -> None:
+    """A fresh node fast-syncs although providers serve a capped number of
+    tampered block responses: redo + scoreboard strikes, never a wedge."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_block_sync import SyncNode, build_chain
+    from tendermint_tpu import crypto
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.p2p import InProcNetwork
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+
+    os.environ.setdefault("TMTPU_BATCH_BACKEND", "host")
+    pv = MockPV(crypto.Ed25519PrivKey.generate(b"\x42" * 32))
+    genesis = GenesisDoc(
+        chain_id="sync-chain", genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)])
+
+    async def run():
+        from dataclasses import replace
+
+        from tendermint_tpu.consensus.config import test_consensus_config
+
+        quiet = replace(test_consensus_config(), create_empty_blocks=False)
+        chain = build_chain(40, pv, genesis)
+        src_a = SyncNode("src_a", genesis, pv=pv, fast_sync=False,
+                         chain=chain, config=quiet)
+        src_b = SyncNode("src_b", genesis, pv=None, fast_sync=True,
+                         config=quiet)
+        fresh = SyncNode("fresh", genesis, pv=None, fast_sync=True,
+                         config=quiet)
+        net = InProcNetwork()
+        for nd in (src_a, src_b, fresh):
+            net.add_switch(nd.switch)
+        await src_a.start()
+        await src_b.start()
+        await net.connect("src_a", "src_b")
+        # second source catches up honestly first, then serves too
+        await asyncio.wait_for(src_b.bc_reactor.synced.wait(), timeout=120)
+        # arm AFTER the honest warm-up: the very next served block response
+        # is tampered (*1 => exactly one injection, every seed, every run)
+        faults.configure("blocksync.bad_block*1", seed=seed)
+
+        async def rewire():
+            # a corrupted response that fails decode drops the link; the
+            # in-proc analog of persistent-peer redial keeps serving alive
+            while True:
+                await asyncio.sleep(0.3)
+                await net.reconnect_missing()
+
+        rewire_task = asyncio.create_task(rewire())
+        await fresh.start()
+        await net.connect("src_a", "fresh")
+        await net.connect("src_b", "fresh")
+        try:
+            await asyncio.wait_for(fresh.bc_reactor.synced.wait(), timeout=120)
+            assert fresh.state_store.load().last_block_height >= 39
+        finally:
+            rewire_task.cancel()
+            for nd in (fresh, src_b, src_a):
+                await nd.stop()
+        return fresh
+
+    fresh = asyncio.run(run())
+    fires = faults.fires("blocksync.bad_block")
+    assert fires == 1, f"expected exactly 1 injection, saw {fires}"
+    strikes = sum(s["total_failures"]
+                  for s in fresh.bc_reactor.scoreboard.snapshot().values())
+    assert strikes > 0, "victim never struck a lying provider"
+
+
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
     "device.vote_flush": cell_device_vote_flush,
     "wal.fsync": cell_wal_fsync,
     "db.write_batch": cell_db_write_batch,
     "net.drop": cell_net_drop,
+    "net.corrupt": cell_net_corrupt,
+    "statesync.lying_chunk": cell_statesync_lying_chunk,
+    "statesync.lying_snapshot": cell_statesync_lying_snapshot,
+    "blocksync.bad_block": cell_blocksync_bad_block,
+    "combo.maverick_corrupt": cell_combo_maverick_corrupt,
 }
 assert set(CELLS) == set(SITES)
 
@@ -286,12 +617,17 @@ def self_test() -> None:
     assert txt.splitlines()[0].startswith("site"), txt
     # registry closed under CELLS/SITES (module asserts at import too)
     assert all(s in CELLS for s in SITES)
-    # the two cheapest cells in-process: the injection seams really work
+    # the cheapest cells in-process: the injection seams really work
     from tendermint_tpu.libs.faults import faults
 
     cell_db_write_batch(seed=1)
     faults.reset()
     cell_wal_fsync(seed=1)
+    faults.reset()
+    # the Byzantine statesync cells are jax-free and fast: run them too
+    cell_statesync_lying_chunk(seed=1)
+    faults.reset()
+    cell_statesync_lying_snapshot(seed=1)
     faults.reset()
     print("chaos_matrix self-test OK")
 
