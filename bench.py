@@ -27,6 +27,7 @@ verdict fetch, exactly what the consensus/blocksync callers pay.
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -38,11 +39,12 @@ N_BASE = 2048
 
 def _enable_compile_cache():
     try:
-        import jax
+        from tendermint_tpu.libs.compilecache import enable_compile_cache
 
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        warn = enable_compile_cache(
+            os.path.join(os.path.dirname(__file__), ".jax_cache"))
+        if warn:  # stderr: stdout is the driver-parsed JSONL stream
+            print(warn, file=sys.stderr)
     except Exception:
         pass
 
@@ -863,19 +865,24 @@ def bench_verify_commit_10k():
         for i in range(0, n_commits, window):
             verify_window(per_commit[i:i + window])
 
+    from tendermint_tpu.crypto import phases
+
     warm_pc = build_slice(1)
     sustained(warm_pc)  # compile + warm the pk device cache
     # min-of-5 with FRESH inputs per repeat: the relay's effective bandwidth
     # swings 2-4x hour to hour, but its cache must never turn a repeat into
     # a no-op; per-repeat values land in the JSON for auditability
-    repeat_times = []
+    repeat_times, repeat_marks = [], []
     for rep in range(repeats):
         pc = build_slice(1000 + rep * n_commits)  # untimed setup
         t0 = time.perf_counter()
         sustained(pc)
-        repeat_times.append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        repeat_times.append(t1 - t0)
+        repeat_marks.append((t0, t1))
         del pc
-    best = min(repeat_times)
+    best_i = int(np.argmin(repeat_times))
+    best = repeat_times[best_i]
     total_sigs = n_commits * n_vals
     dev_rate = total_sigs / best
 
@@ -883,15 +890,14 @@ def bench_verify_commit_10k():
     pubs = [crypto.Ed25519PubKey(p) for p in warm_pc[0][0][:N_BASE]]
     host_rate = _host_rate(pubs, warm_pc[0][1], warm_pc[0][2], N_BASE)
 
-    # stage breakdown for the sustained path: host packing per pipeline
-    # segment (2 commits = 10 chunks each, the segmented path's unit)
-    t0 = time.perf_counter()
-    for i in range(0, n_commits, 2):
-        cs = warm_pc[i:i + 2]
-        V.prepare_sparse_stream([p for c in cs for p in c[0]],
-                                [m for c in cs for m in c[1]],
-                                [s for c in cs for s in c[2]], CHUNK)
-    pack_s = time.perf_counter() - t0
+    # stage breakdown from the dispatcher's OWN phase telemetry
+    # (crypto/phases.py): the per-segment pack/dispatch/fetch stamps
+    # recorded during the best timed repeat, decomposed by interval union —
+    # no more hand-placed perf_counter pair re-packing outside the run
+    w0, w1 = repeat_marks[best_i]
+    recs = [r for r in phases.recent_segments()
+            if r["t0"] >= w0 and r["t_end"] <= w1 + 1e-6]
+    bd = phases.phase_breakdown(recs, w0, w1) if recs else None
 
     # one-shot: single cold commit, one call — three DISTINCT commits so
     # the relay cache can't serve run 2 and 3 from run 1
@@ -900,8 +906,37 @@ def bench_verify_commit_10k():
               for c in oneshot_pc)
     _emit("verify_commit_10k_oneshot_sigs_per_sec", n_vals / one, "sigs/s",
           (n_vals / one) / host_rate)
-    _emit("verify_commit_10k_breakdown_pack_share", pack_s / best, "ratio",
-          0.0, pack_seconds=round(pack_s, 3), total_seconds=round(best, 3))
+    if bd is not None:
+        # gated lower-is-better by tools/bench_compare.py (the 7% -> 11.1%
+        # r04->r05 packing creep ran ungated): total pack seconds across
+        # all pipeline threads over the best repeat's wall
+        _emit("verify_commit_10k_breakdown_pack_share",
+              bd["pack_share_total"], "ratio", 0.0,
+              pack_seconds=round(bd["pack_s"], 3),
+              total_seconds=round(best, 3),
+              segments=bd["segments"], source="phase_telemetry")
+        # per-phase wall decomposition: exposed pack + exposed dispatch +
+        # device-in-flight union tile the wall, so their sum (the accounted
+        # share) must come within 10% of end-to-end wall time — the
+        # telemetry indicting itself if a phase goes missing
+        acc = bd["accounted_share"]
+        # an accounting shortfall (>10% of wall unattributed) means a
+        # dispatch phase is going unrecorded — flag it with the crashed-
+        # config unit convention so bench_compare surfaces it, but never
+        # abort the run over an environment-dependent accounting gap
+        _emit("verify_commit_10k_phase_shares", acc,
+              "ratio" if acc >= 0.90 else "error", 0.0,
+              pack_share=round(bd["pack_share_exposed"], 3),
+              dispatch_share=round(bd["dispatch_share_exposed"], 3),
+              device_share=round(bd["device_share"], 3),
+              pack_share_total=round(bd["pack_share_total"], 3),
+              overlap_ratio=round(bd["overlap_ratio"], 3),
+              fetch_wait_seconds=round(bd["wait_s"], 3),
+              segments=bd["segments"],
+              accounted_within_10pct=bool(acc >= 0.90))
+    else:
+        _emit("verify_commit_10k_breakdown_pack_share", 0.0, "error", 0.0,
+              error="no phase records captured during the timed repeats")
     _emit("verify_commit_10k_sigs_per_sec", dev_rate, "sigs/s",
           dev_rate / host_rate,
           per_repeat_seconds=[round(t, 3) for t in repeat_times],

@@ -45,6 +45,7 @@ from ..state.state import State
 from ..store import BlockStore
 from ..types.basic import BlockID
 from ..types.block import Block
+from ..crypto import phases
 from ..crypto.batch import BatchVerifier, precomputed_verdicts
 from ..libs.faults import faults
 from ..libs.metrics import BlocksyncMetrics, Registry
@@ -417,8 +418,13 @@ class BlockchainReactor(Reactor):
         apply stage re-derives none of it."""
         with tracer.span("verify_window", height=pairs[0][0].header.height,
                          n_blocks=len(pairs)):
-            return self._stage_a_inner(window, pairs, vals_hash, first_vals,
-                                       vals, chain_id)
+            # height-tag the window's device segments: the seg_pack/
+            # seg_dispatch/seg_fetch spans and phase records carry the
+            # first height so trace tooling can line device-pipeline
+            # occupancy up against the consensus stage timeline
+            with phases.telemetry(height=pairs[0][0].header.height):
+                return self._stage_a_inner(window, pairs, vals_hash,
+                                           first_vals, vals, chain_id)
 
     def _stage_a_inner(self, window, pairs, vals_hash, first_vals, vals,
                        chain_id) -> _PreparedWindow:

@@ -73,16 +73,18 @@ def cmd_start(args) -> int:
     # persistent XLA compile cache: the batched-verify kernels take minutes
     # to compile cold; without this every fresh node process pays that on
     # its first device-routed batch (TMTPU_JAX_CACHE overrides, e.g. the
-    # e2e runner points all subprocess nodes at one shared cache). Must use
-    # the config API, not env: this image's sitecustomize imports jax at
-    # interpreter startup, so import-time env reads have already happened.
+    # e2e runner points all subprocess nodes at one shared cache). The
+    # helper also fingerprints the cache dir and warns LOUDLY when it was
+    # built on a host with different CPU features — the cpu_aot_loader
+    # SIGILL risk otherwise buried in stderr (MULTICHIP_r05.json).
     try:
-        import jax
+        from .libs.compilecache import enable_compile_cache
 
         cache = os.environ.get("TMTPU_JAX_CACHE") or os.path.join(
             args.home, ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        warn = enable_compile_cache(cache)
+        if warn:
+            logging.getLogger("tmtpu.node").warning("%s", warn)
     except Exception:
         pass
     cfg = Config.load(args.home)

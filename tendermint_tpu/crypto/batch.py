@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import Ed25519PubKey, PubKey
+from . import phases as _phases
 from ..libs.faults import faults
 from ..libs.trace import tracer
 from .breaker import classify_device_error, device_breaker
@@ -269,6 +270,10 @@ class BatchVerifier:
                 out = _host_verify()
         stats["device_batches" if route == "device" else "host_batches"] += 1
         stats["device_sigs" if route == "device" else "host_sigs"] += n
+        if route != "device":
+            # scalar-routed (or device-fallback) batches record zero device
+            # phases but still count on the device plane's ledger
+            _phases.count_host(self.plane, n)
         if metrics is not None:
             elapsed = time.perf_counter() - t0
             metrics.routing_decisions_total.labels(route, self.plane).inc()

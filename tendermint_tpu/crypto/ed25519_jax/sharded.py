@@ -19,6 +19,7 @@ headroom), and the host recombines ``Σ psum_j · 2^8j`` in Python ints.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -27,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import phases
 from .verify import LANE, _pad_to, _verify_kernel, pack_device_inputs, prepare_batch
 
 AXIS = "sig_batch"
@@ -111,6 +113,11 @@ def batch_verify_sharded(
             f"batch of {n} exceeds the exact-tally bound {MAX_EXACT_SIGS}; "
             "split into multiple calls"
         )
+    # phase record: one segment spread over the whole mesh; per-device
+    # dispatch/in-flight series get every mesh device's label
+    labels = [f"{dev.platform}:{dev.id}" for dev in mesh.devices.flat]
+    rec = phases.Segment(sigs=n, chunk=0, device=f"mesh[{d}]",
+                         devices=labels).begin()
     blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
     # round up to a multiple of d*LANE so the B axis divides across the mesh
     unit = d * LANE
@@ -131,8 +138,16 @@ def batch_verify_sharded(
         put(dev_in[0], BLOCK_SPEC), put(dev_in[1], FLAG_SPEC),
         put(dev_in[2], WORD_SPEC), put(limbs, WORD_SPEC),
     )
-    verdict, total_limbs = _sharded_step(mesh)(*args)
-    verdict = np.asarray(verdict).reshape(-1)[:n] & ok
-    tl = np.asarray(total_limbs)
+    rec.chunk = pad
+    rec.pack_done()
+    verdict_d, total_limbs = _sharded_step(mesh)(*args)
+    rec.dispatched()
+    try:
+        t_w = time.perf_counter()
+        verdict = np.asarray(verdict_d).reshape(-1)[:n] & ok
+        tl = np.asarray(total_limbs)
+        rec.fetched(wait_s=time.perf_counter() - t_w)
+    finally:
+        rec.abandon()  # failed fetch must not wedge the in-flight gauges
     total = sum(int(tl[j]) << (POWER_LIMB_BITS * j) for j in range(POWER_LIMBS))
     return verdict, total

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from functools import partial
 from typing import Sequence, Tuple
 
@@ -44,6 +45,7 @@ from . import curve
 from . import field as F
 from . import scalar as S
 from . import sha512 as H
+from .. import phases
 from ..ed25519 import L
 
 LANE = 128  # batch is reshaped to (B, 128) so per-limb ops fill (8,128) vregs
@@ -442,6 +444,21 @@ def _group_by_bucket(msgs: Sequence[bytes]):
     return groups
 
 
+_DEV_LABEL = None
+
+
+def _device_label() -> str:
+    """Default device as a stable metric label ('cpu:0', 'tpu:0', ...)."""
+    global _DEV_LABEL
+    if _DEV_LABEL is None:
+        try:
+            d = jax.devices()[0]
+            _DEV_LABEL = f"{d.platform}:{d.id}"
+        except Exception:
+            return "device"
+    return _DEV_LABEL
+
+
 def batch_verify(
     pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
@@ -457,26 +474,31 @@ def batch_verify(
                                      [msgs[i] for i in idxs],
                                      [sigs[i] for i in idxs])
         return out
+    rec = phases.Segment(sigs=n, chunk=_pad_to(n),
+                         device=_device_label()).begin()
     blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
     bucket = next(iter(groups))
     if blocks_w.shape[1] < bucket:  # pad NBLK up to the bucket size
         blocks_w = np.pad(blocks_w, ((0, 0), (0, bucket - blocks_w.shape[1]), (0, 0)))
     dev_in = pack_device_inputs(blocks_w, nblk, s_words, _pad_to(n))
-    verdict = np.asarray(_verify_kernel(*dev_in)).reshape(-1)[:n]
+    rec.pack_done()
+    dev = _verify_kernel(*dev_in)
+    rec.dispatched()
+    try:
+        t_w = time.perf_counter()
+        verdict = np.asarray(dev).reshape(-1)[:n]
+        rec.fetched(wait_s=time.perf_counter() - t_w)
+    finally:
+        rec.abandon()  # failed fetch must not wedge the in-flight gauge
     return verdict & ok
 
 
-def _dispatch_stream(pks, msgs, sigs, chunk: int):
-    """Pack one whole-chunk segment and dispatch it (sparse path if the
-    messages are template-compressible, dense otherwise). Returns
-    (device_verdict, ok_mask) WITHOUT fetching — the caller decides when to
-    block, which is what lets the pipeline overlap host packing and
-    host->device transfer of segment i+1 with device compute of segment i."""
+def _pack_stream_dense(pks, msgs, sigs, chunk: int):
+    """Dense stream packing: (kernel args (K, ..) tuple, ok mask). Shared
+    by _dispatch_stream's dense branch and tools/device_profile.py's
+    per-device scale cells (which device_put the same arrays onto an
+    explicit device)."""
     n = len(pks)
-    sparse = prepare_sparse_stream(pks, msgs, sigs, chunk)
-    if sparse is not None:
-        args, ok = sparse
-        return _verify_sparse_stream_kernel(*args), ok
     blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
     bucket = _nblk_bucket(max(map(len, msgs)))
     if blocks_w.shape[1] < bucket:
@@ -496,7 +518,23 @@ def _dispatch_stream(pks, msgs, sigs, chunk: int):
     s_d = np.ascontiguousarray(
         s_words.reshape(k, chunk, 8).transpose(0, 2, 1)
     ).reshape(k, 8, b, LANE)
-    return _verify_stream_kernel(blocks_d, nblk_d, s_d), ok
+    return (blocks_d, nblk_d, s_d), ok
+
+
+def _dispatch_stream(pks, msgs, sigs, chunk: int):
+    """Pack one whole-chunk segment and dispatch it (sparse path if the
+    messages are template-compressible, dense otherwise). Returns
+    (device_verdict, ok_mask) WITHOUT fetching — the caller decides when to
+    block, which is what lets the pipeline overlap host packing and
+    host->device transfer of segment i+1 with device compute of segment i."""
+    sparse = prepare_sparse_stream(pks, msgs, sigs, chunk)
+    if sparse is not None:
+        args, ok = sparse
+        phases.mark_pack_done()
+        return _verify_sparse_stream_kernel(*args), ok
+    args, ok = _pack_stream_dense(pks, msgs, sigs, chunk)
+    phases.mark_pack_done()
+    return _verify_stream_kernel(*args), ok
 
 
 # Segmented pipelining: on remote-attached TPUs the relay serializes each
@@ -534,7 +572,23 @@ def _segment_sizes(k_total: int) -> list:
     return [base + (1 if i < extra else 0) for i in range(n_segs)]
 
 
-def _verify_segmented(pks, msgs, sigs, chunk: int) -> np.ndarray:
+def _run_dispatch(rec, pks, msgs, sigs, chunk: int):
+    """One segment's pack + async dispatch with phase stamps, on whatever
+    thread runs it (segment 0 / single-dispatch: the caller; pipeline
+    segments: a worker). The active-segment slot lets _dispatch_stream
+    close the pack phase from inside without changing its signature."""
+    rec.begin()
+    prev = phases.set_active(rec)
+    try:
+        dev, ok = _dispatch_stream(pks, msgs, sigs, chunk)
+    finally:
+        phases.clear_active(prev)
+    rec.dispatched()
+    return dev, ok
+
+
+def _verify_segmented(pks, msgs, sigs, chunk: int,
+                      t_entry: float = None) -> np.ndarray:
     n = len(pks)
     sizes = _segment_sizes(-(-n // chunk))
     bounds, lo = [], 0
@@ -542,26 +596,53 @@ def _verify_segmented(pks, msgs, sigs, chunk: int) -> np.ndarray:
         hi = min(lo + s * chunk, n)
         bounds.append((lo, hi))
         lo = hi
+    # phase records: plane/height captured HERE (contextvars do not follow
+    # work onto the pipeline workers), stamps filled on whichever thread
+    # packs/dispatches, closed on this thread at fetch
+    plane, height = phases.context()
+    dev_label = _device_label()
+    recs = [phases.Segment(sigs=b - a, chunk=chunk, seg=i,
+                           n_segs=len(bounds), device=dev_label,
+                           plane=plane, height=height)
+            for i, (a, b) in enumerate(bounds)]
+    if t_entry is not None:
+        # charge the stream entry's host work (bucket grouping over every
+        # message) to segment 0's pack phase: it is critical-path packing
+        # cost, and leaving it unattributed would leave a hole in the
+        # wall-clock accounting bench.py asserts over
+        recs[0].t0 = t_entry
     pool = _seg_pool()
     # segment 0 packs+dispatches on the calling thread: on a cold jit cache
     # two workers would race to trace the same kernel shape (JAX does not
     # guarantee single-flight compilation across threads); dispatch is async
     # so the pipeline overlap is unaffected
     a0, b0 = bounds[0]
-    futs = [_done_future(_dispatch_stream(
-        pks[a0:b0], msgs[a0:b0], sigs[a0:b0], chunk))]
+    futs = [_done_future(_run_dispatch(
+        recs[0], pks[a0:b0], msgs[a0:b0], sigs[a0:b0], chunk))]
     futs += [
-        pool.submit(_dispatch_stream, pks[a:b], msgs[a:b], sigs[a:b], chunk)
+        pool.submit(_run_dispatch, recs[1], pks[a:b], msgs[a:b], sigs[a:b],
+                    chunk)
         for a, b in bounds[1:2]
     ]
     out = np.zeros(n, dtype=bool)
-    for i, (a, b) in enumerate(bounds):
-        dev, ok = futs[i].result()
-        if i + 2 < len(bounds):
-            a2, b2 = bounds[i + 2]
-            futs.append(pool.submit(
-                _dispatch_stream, pks[a2:b2], msgs[a2:b2], sigs[a2:b2], chunk))
-        out[a:b] = np.asarray(dev).reshape(-1)[:b - a] & ok
+    try:
+        for i, (a, b) in enumerate(bounds):
+            t_wait0 = time.perf_counter()
+            dev, ok = futs[i].result()
+            if i + 2 < len(bounds):
+                a2, b2 = bounds[i + 2]
+                futs.append(pool.submit(
+                    _run_dispatch, recs[i + 2], pks[a2:b2], msgs[a2:b2],
+                    sigs[a2:b2], chunk))
+            arr = np.asarray(dev)
+            recs[i].fetched(wait_s=time.perf_counter() - t_wait0)
+            out[a:b] = arr.reshape(-1)[:b - a] & ok
+    finally:
+        # an errored fetch (or a sibling segment's worker raising) must
+        # drain the in-flight gauge for every already-dispatched segment
+        for r in recs:
+            r.abandon()
+    phases.observe_overlap(recs)
     return out
 
 
@@ -581,6 +662,7 @@ def batch_verify_stream(
     as few device executions as possible: one per SEG_CHUNKS-chunk segment,
     double-buffered so segment i+1's host packing and transfer overlap
     segment i's device compute (amortizes per-dispatch overhead)."""
+    t_entry = time.perf_counter()
     n = len(pks)
     if n == 0:
         return np.zeros(0, dtype=bool)
@@ -597,6 +679,14 @@ def batch_verify_stream(
                                             [sigs[i] for i in idxs], chunk)
         return out
     if n >= SEG_MIN_SIGS and n > chunk:
-        return _verify_segmented(pks, msgs, sigs, chunk)
-    dev, ok = _dispatch_stream(pks, msgs, sigs, chunk)
-    return np.asarray(dev).reshape(-1)[:n] & ok
+        return _verify_segmented(pks, msgs, sigs, chunk, t_entry=t_entry)
+    rec = phases.Segment(sigs=n, chunk=chunk, device=_device_label())
+    rec.t0 = t_entry  # bucket grouping is critical-path pack cost
+    dev, ok = _run_dispatch(rec, pks, msgs, sigs, chunk)
+    try:
+        t_w = time.perf_counter()
+        arr = np.asarray(dev)
+        rec.fetched(wait_s=time.perf_counter() - t_w)
+    finally:
+        rec.abandon()
+    return arr.reshape(-1)[:n] & ok

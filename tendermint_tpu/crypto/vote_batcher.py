@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 from ..libs.faults import faults
 from ..libs.trace import tracer
 from . import batch as _batch  # module ref: reads the live metrics hook
+from . import phases as _phases
 from .breaker import classify_device_error, device_breaker
 
 logger = logging.getLogger("tmtpu.votebatch")
@@ -142,6 +143,13 @@ class BatchVoteVerifier:
         route = "scalar"
 
         def _host_verify():
+            # live-plane batch verified on host: zero device phases, still
+            # counted (crypto/phases.py host ledger). On the device-timeout
+            # path the background flush ALSO records device segments for
+            # the same votes when it completes — that is real duplicated
+            # work (both verifies ran), and the ledger counts work done,
+            # not unique votes
+            _phases.count_host("live", n)
             return [Ed25519PubKey(pk).verify_signature(m, s)
                     for _key, pk, m, s, _fut in batch]
 
@@ -170,7 +178,12 @@ class BatchVoteVerifier:
                     faults.inject("device.vote_flush")
                     from .ed25519_jax import batch_verify_stream
 
-                    return batch_verify_stream(pks, msgs, sigs)
+                    # plane=live set INSIDE the thunk: contextvars do not
+                    # follow run_in_executor onto the worker thread, and the
+                    # flush's pack/dispatch/fetch must land in the phase
+                    # histograms next to the sync plane's segments
+                    with _phases.telemetry(plane="live"):
+                        return batch_verify_stream(pks, msgs, sigs)
 
                 dev = loop.run_in_executor(None, _device_verify)
                 try:

@@ -1,0 +1,154 @@
+"""Persistent XLA compile-cache setup with host-feature fingerprinting.
+
+The persistent compile cache is load-bearing (the ed25519 verify kernels
+take minutes to compile cold on CPU), but it carries a footgun: XLA:CPU
+caches AOT-compiled machine code, and a cache directory populated on a
+machine with different CPU features loads anyway — ``cpu_aot_loader``
+prints a wall of "Machine type used for XLA:CPU compilation doesn't match
+the machine type for execution ... could lead to execution errors such as
+SIGILL" to stderr (see MULTICHIP_r05.json's tail for the real artifact) and
+the process may die mid-dispatch.
+
+This module is the one place cache dirs get enabled. It stamps each cache
+directory with a host fingerprint (machine arch + a hash of the CPU
+feature flags) on first use and, when a later process finds a stamp from a
+DIFFERENT host, returns a loud human-readable warning for the caller to
+log at startup — instead of the risk living only in buried stderr. The
+last check's outcome is kept in module state so debugdump's ``device.json``
+can carry it post-mortem (:func:`status`).
+
+Fingerprinting is advisory: any I/O failure degrades to "no warning", never
+to a broken cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform
+import tempfile
+import time
+from typing import Dict, Optional
+
+#: stamp file written inside the cache dir (ignored by XLA's key lookups)
+MARKER_NAME = "tmtpu_host_fingerprint.json"
+
+_status: Dict = {"cache_dir": None, "fingerprint": None, "marker": None,
+                 "mismatch": None}
+
+
+def _cpu_flags() -> str:
+    """Sorted CPU feature flags from /proc/cpuinfo ('' when unavailable —
+    e.g. macOS — which degrades to arch-only fingerprinting)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return " ".join(sorted(line.split(":", 1)[1].split()))
+    except OSError:
+        pass
+    return ""
+
+
+def host_fingerprint() -> Dict:
+    flags = _cpu_flags()
+    return {
+        "machine": platform.machine(),
+        "flags_sha256": hashlib.sha256(flags.encode()).hexdigest(),
+        "n_flags": len(flags.split()),
+    }
+
+
+def check_cache_dir(cache_dir: str) -> Optional[str]:
+    """Stamp ``cache_dir`` with this host's fingerprint, or compare against
+    an existing stamp. Returns a warning string when the cache was built on
+    a host with different CPU features (the cpu_aot_loader SIGILL risk),
+    else None."""
+    fp = host_fingerprint()
+    _status.update(cache_dir=cache_dir, fingerprint=fp, marker=None,
+                   mismatch=None)
+    marker = os.path.join(cache_dir, MARKER_NAME)
+    try:
+        prev = None
+        if os.path.exists(marker):
+            try:
+                with open(marker) as f:
+                    prev = json.load(f)
+            except (OSError, ValueError):
+                prev = None  # torn/unreadable marker: re-stamp below —
+                # a broken marker must not silently disable the warning
+        if prev is not None:
+            _status["marker"] = prev
+            if (prev.get("machine"), prev.get("flags_sha256")) != \
+                    (fp["machine"], fp["flags_sha256"]):
+                warn = (
+                    f"persistent XLA compile cache {cache_dir!r} was built "
+                    f"on a host with different CPU features (cache: "
+                    f"{prev.get('machine')}/"
+                    f"{str(prev.get('flags_sha256'))[:12]}, this host: "
+                    f"{fp['machine']}/{fp['flags_sha256'][:12]}) — cached "
+                    "XLA:CPU AOT kernels can SIGILL at dispatch "
+                    "(cpu_aot_loader); delete the cache directory to "
+                    "recompile for this host")
+                _status["mismatch"] = warn
+                return warn
+        else:
+            os.makedirs(cache_dir, exist_ok=True)
+            # a marker-less dir that ALREADY holds cache entries predates
+            # the fingerprint (or was copied here): its origin is
+            # unverifiable — the MULTICHIP_r05 scenario exactly. Warn once,
+            # then stamp with origin recorded, so a cache genuinely built
+            # on this host doesn't cry wolf forever while a copied one
+            # still got its one loud startup warning.
+            has_entries = any(not name.startswith(MARKER_NAME)
+                              for name in os.listdir(cache_dir))
+            doc = dict(fp, written_unix=time.time(),
+                       origin=("preexisting-unverified" if has_entries
+                               else "fresh"))
+            # unique tmp per process: N nodes pointed at one shared
+            # TMTPU_JAX_CACHE all stamp at first start, and a fixed tmp
+            # path could interleave writers into a torn marker
+            fd, tmp = tempfile.mkstemp(prefix=MARKER_NAME + ".",
+                                       dir=cache_dir)
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, marker)
+            _status["marker"] = doc
+            if has_entries:
+                warn = (
+                    f"persistent XLA compile cache {cache_dir!r} already "
+                    "holds entries but carries no host fingerprint — if it "
+                    "was copied from another machine its XLA:CPU AOT "
+                    "kernels can SIGILL at dispatch (cpu_aot_loader). "
+                    "Stamped with THIS host's fingerprint; delete the "
+                    "cache directory if it came from elsewhere")
+                _status["mismatch"] = warn
+                return warn
+    except Exception:
+        pass  # advisory only
+    return None
+
+
+def enable_compile_cache(cache_dir: str,
+                         min_compile_secs: int = 2) -> Optional[str]:
+    """Point jax's persistent compile cache at ``cache_dir`` (config API,
+    not env: this image's sitecustomize imports jax at interpreter startup,
+    so import-time env reads have already happened) and run the host-
+    fingerprint check. Returns the mismatch warning for the caller to log,
+    or None."""
+    warn = check_cache_dir(cache_dir)
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:
+        pass
+    return warn
+
+
+def status() -> Dict:
+    """Last check's outcome (for debugdump device.json)."""
+    return dict(_status)

@@ -34,6 +34,8 @@ _INSTALLED: dict = {}
 # how many trailing trace events land in the dump bundle (full rings are
 # 64k events — the tail is what describes the moments before the wedge)
 _TRACE_TAIL_EVENTS = 512
+# how many trailing device-segment phase records ride in device.json
+_DEVICE_SEGMENT_TAIL = 64
 # how many sealed heights of the consensus stage timeline ride along
 _TIMELINE_TAIL_HEIGHTS = 32
 # give the off-thread metrics render this long before the dump moves on
@@ -123,6 +125,43 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
         if tl is not None:
             with open(os.path.join(out_dir, "stage_timeline.json"), "w") as f:
                 json.dump(tl.snapshot(_TIMELINE_TAIL_HEIGHTS), f, indent=1)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+    # device-plane snapshot (crypto/phases.py + libs/compilecache.py): the
+    # jax backend + device inventory, cumulative phase stats, the last-N
+    # segment records, and whether the persistent compile cache was built
+    # for THIS host's CPU features (the cpu_aot_loader SIGILL footgun) —
+    # a wedged or SIGILL-adjacent dispatch must be attributable post-mortem
+    try:
+        import json
+
+        from ..crypto import phases
+
+        doc = {
+            "phase_totals": phases.phase_totals(),
+            "recent_segments": phases.recent_segments(_DEVICE_SEGMENT_TAIL),
+        }
+        try:
+            from . import compilecache
+
+            doc["compile_cache"] = compilecache.status()
+        except Exception as e:
+            doc["compile_cache"] = f"unavailable: {e}"
+        # report jax only if this process already imported it: a dump
+        # handler must never pay (or wedge on) a cold jax/backend init
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                doc["jax_backend"] = jax.default_backend()
+                doc["devices"] = [f"{d.platform}:{d.id}"
+                                  for d in jax.devices()]
+            except Exception as e:
+                doc["jax_error"] = f"{type(e).__name__}: {e}"
+        else:
+            doc["jax_backend"] = None
+        with open(os.path.join(out_dir, "device.json"), "w") as f:
+            json.dump(doc, f, indent=1, default=str)
     except Exception:
         traceback.print_exc(file=sys.stderr)
 

@@ -167,15 +167,20 @@ class Tracer:
         self._record(ev)
 
     def complete(self, name: str, ts_us: float, dur_us: float,
-                 **args) -> None:
+                 tid: Optional[int] = None, **args) -> None:
         """Record a complete event with an EXPLICIT start/duration (both in
         perf_counter microseconds) — for retroactive spans whose endpoints
         were sampled outside a context manager (the consensus stage
-        timeline seals a height and emits one span per stage interval)."""
+        timeline seals a height and emits one span per stage interval).
+        ``tid`` overrides the emitting thread's id: retroactive spans for
+        work that ran elsewhere (a pipeline slot's pack on a worker) would
+        otherwise render overlapping slices on the emitter's track."""
         if not self.enabled:
             return
         ev = {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
-              "pid": _PID, "tid": threading.get_ident() & 0x7FFFFFFF}
+              "pid": _PID,
+              "tid": (tid if tid is not None
+                      else threading.get_ident() & 0x7FFFFFFF)}
         if args:
             ev["args"] = args
         self._record(ev)

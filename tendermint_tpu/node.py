@@ -220,6 +220,12 @@ class Node:
         from .crypto.batch import set_crypto_metrics
 
         set_crypto_metrics(self.metrics.crypto)
+        # device-plane phase telemetry (crypto/phases.py): per-segment
+        # pack/dispatch/fetch histograms, per-device dispatch counters,
+        # and the pipeline-overlap gauge onto the same registry
+        from .crypto import phases as _phases
+
+        _phases.set_device_metrics(self.metrics.device)
         self.blockchain_reactor.metrics = self.metrics.blocksync
         # the provider scoreboard counts its bans on the SHARED registry
         # too (it was constructed against the reactor's private set)

@@ -25,6 +25,7 @@ def _reset_fault_state():
     """Fail-point counters, armed fault sites, and breaker state are
     process-global by design (subprocess nodes arm them from env) — reset
     around every test so one test's chaos can't leak into the next."""
+    from tendermint_tpu.crypto import phases
     from tendermint_tpu.crypto.breaker import device_breaker
     from tendermint_tpu.libs import fail
     from tendermint_tpu.libs.faults import faults
@@ -32,10 +33,14 @@ def _reset_fault_state():
     fail.reset()
     faults.reset()
     device_breaker.reset()
+    phases.reset()
+    phases.set_device_metrics(None)
     yield
     fail.reset()
     faults.reset()
     device_breaker.reset()
+    phases.reset()
+    phases.set_device_metrics(None)
 
 
 def pytest_collection_modifyitems(config, items):

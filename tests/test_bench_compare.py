@@ -39,14 +39,23 @@ def test_self_test_passes():
     assert "self-test OK" in res.stdout
 
 
-def test_real_history_gates_clean():
-    """r04 -> r05 was an improvement round: the gate must pass, and the
-    repo's checked-in history must stay parseable forever."""
+def test_real_history_trips_on_pack_share_creep():
+    """r04 -> r05 improved every throughput metric BUT let the flagship's
+    packing share creep 7% -> 11.1% (+59%) with nothing watching. With the
+    pack-share ratio gated lower-is-better, the checked-in history itself
+    must now trip exit 1 on exactly that metric — and on nothing else."""
     r04 = os.path.join(REPO, "BENCH_r04.json")
     r05 = os.path.join(REPO, "BENCH_r05.json")
     res = _run(r04, r05)
-    assert res.returncode == 0, res.stdout + res.stderr
-    assert "OK: no regressions" in res.stdout
+    assert res.returncode == 1, res.stdout + res.stderr
+    fail = next(l for l in res.stdout.splitlines() if l.startswith("FAIL"))
+    assert "verify_commit_10k_breakdown_pack_share" in fail
+    assert fail.startswith("FAIL: 1 regression(s)"), fail
+    # loosening that one metric's threshold restores a clean r04 -> r05
+    res2 = _run("--threshold",
+                "verify_commit_10k_breakdown_pack_share=0.6", r04, r05)
+    assert res2.returncode == 0, res2.stdout
+    assert "OK: no regressions" in res2.stdout
     bc = _mod()
     run = bc.load_bench(r05)
     assert run["verify_commit_10k_sigs_per_sec"]["value"] > 150000
@@ -96,12 +105,18 @@ def test_missing_gated_metric_fails(tmp_path):
 
 def test_trajectory_table_over_history():
     files = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in (3, 4, 5)]
-    res = _run(*files)
+    # the pack-share gate trips on the raw r04 -> r05 pair (see above);
+    # loosened here so this test isolates the trajectory rendering
+    res = _run("--threshold",
+               "verify_commit_10k_breakdown_pack_share=0.6", *files)
     assert res.returncode == 0, res.stdout + res.stderr
     # all three runs' flagship values appear in one row
     line = next(l for l in res.stdout.splitlines()
                 if l.startswith("verify_commit_10k_sigs_per_sec "))
     assert "157880" in line and "47384" in line
+    # the gated pack share joined the trajectory table
+    assert any(l.startswith("verify_commit_10k_breakdown_pack_share")
+               for l in res.stdout.splitlines())
 
 
 def test_parse_error_exits_2(tmp_path):

@@ -1,0 +1,99 @@
+"""libs/compilecache.py: the persistent-XLA-cache host fingerprint. A cache
+dir built on a machine with different CPU features must produce a loud
+startup warning (the cpu_aot_loader SIGILL footgun was previously buried in
+stderr — MULTICHIP_r05.json), and the outcome must be visible to debugdump
+via status()."""
+
+import json
+import os
+
+from tendermint_tpu.libs import compilecache as cc
+
+
+def test_marker_written_then_matches(tmp_path):
+    d = str(tmp_path / "cache")
+    assert cc.check_cache_dir(d) is None  # first use: stamps the dir
+    marker = os.path.join(d, cc.MARKER_NAME)
+    assert os.path.exists(marker)
+    doc = json.load(open(marker))
+    fp = cc.host_fingerprint()
+    assert doc["machine"] == fp["machine"]
+    assert doc["flags_sha256"] == fp["flags_sha256"]
+    # second process on the same host: clean
+    assert cc.check_cache_dir(d) is None
+    st = cc.status()
+    assert st["cache_dir"] == d and st["mismatch"] is None
+
+
+def test_foreign_cache_warns_sigill(tmp_path):
+    d = str(tmp_path / "cache")
+    os.makedirs(d)
+    with open(os.path.join(d, cc.MARKER_NAME), "w") as f:
+        json.dump({"machine": "imaginary-tpu-vm",
+                   "flags_sha256": "deadbeef" * 8, "n_flags": 1}, f)
+    warn = cc.check_cache_dir(d)
+    assert warn is not None
+    assert "SIGILL" in warn and "cpu_aot_loader" in warn
+    assert "imaginary-tpu-vm" in warn
+    assert cc.status()["mismatch"] == warn
+    # the stale marker is NOT silently rewritten: every process on this
+    # host keeps warning until the operator clears the cache dir
+    assert cc.check_cache_dir(d) is not None
+
+
+def test_preexisting_markerless_cache_warns_once_then_stamps(tmp_path):
+    """A cache dir that already holds entries but no fingerprint (built
+    before this feature, or copied from another machine) warns ONCE with
+    the SIGILL wording, records the unverifiable origin in the marker, and
+    goes quiet afterwards — a cache genuinely built on this host doesn't
+    cry wolf forever, and a copied one still got its loud warning."""
+    d = str(tmp_path / "cache")
+    os.makedirs(d)
+    open(os.path.join(d, "jit_foo-abc123-cache"), "w").write("x")
+    warn = cc.check_cache_dir(d)
+    assert warn is not None and "SIGILL" in warn
+    marker = json.load(open(os.path.join(d, cc.MARKER_NAME)))
+    assert marker["origin"] == "preexisting-unverified"
+    assert cc.check_cache_dir(d) is None  # now fingerprint-matched
+
+
+def test_torn_marker_restamps_instead_of_going_silent(tmp_path):
+    """A half-written marker (concurrent first-start stampede on a shared
+    cache dir) must not disable the check forever: it re-stamps as
+    unverifiable origin — with the one-time warning — and then matches."""
+    d = str(tmp_path / "cache")
+    os.makedirs(d)
+    open(os.path.join(d, "jit_foo-abc-cache"), "w").write("x")
+    open(os.path.join(d, cc.MARKER_NAME), "w").write('{"machine": "tru')
+    warn = cc.check_cache_dir(d)
+    assert warn is not None and "SIGILL" in warn
+    marker = json.load(open(os.path.join(d, cc.MARKER_NAME)))
+    assert marker["origin"] == "preexisting-unverified"
+    assert cc.check_cache_dir(d) is None
+
+
+def test_fresh_dir_stamps_silently(tmp_path):
+    d = str(tmp_path / "cache")
+    assert cc.check_cache_dir(d) is None
+    marker = json.load(open(os.path.join(d, cc.MARKER_NAME)))
+    assert marker["origin"] == "fresh"
+
+
+def test_unwritable_dir_degrades_to_no_warning(tmp_path):
+    target = tmp_path / "file-not-dir"
+    target.write_text("x")  # makedirs/marker write will fail
+    assert cc.check_cache_dir(str(target)) is None  # advisory only
+
+
+def test_enable_compile_cache_configures_jax(tmp_path):
+    import jax
+
+    old = jax.config.jax_compilation_cache_dir
+    d = str(tmp_path / "c2")
+    try:
+        assert cc.enable_compile_cache(d) is None
+        assert jax.config.jax_compilation_cache_dir == d
+        assert os.path.exists(os.path.join(d, cc.MARKER_NAME))
+    finally:
+        # the suite's shared cache must keep serving later tests
+        jax.config.update("jax_compilation_cache_dir", old)

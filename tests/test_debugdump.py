@@ -89,3 +89,25 @@ def test_dump_includes_node_state(tmp_path):
     state = open(os.path.join(out, "node_state.txt")).read()
     assert "height=7" in state and "prevote" in state
     assert "ab12" in state
+
+
+def test_dump_includes_device_snapshot(tmp_path):
+    """device.json: phase totals + last-N segment records + compile-cache
+    fingerprint status land in every bundle (jax inventory only when jax is
+    already imported — a dump must not pay a cold backend init)."""
+    import json
+
+    from tendermint_tpu.crypto import phases
+
+    phases.reset()
+    phases.count_host("sync", 3)
+    out = debugdump.write_dump(str(tmp_path / "dump"))
+    doc = json.load(open(os.path.join(out, "device.json")))
+    assert doc["phase_totals"]["host_batches"] == 1
+    assert doc["phase_totals"]["host_sigs"] == 3
+    assert isinstance(doc["recent_segments"], list)
+    assert "compile_cache" in doc
+    # this test process imported jax (conftest pin): inventory present
+    assert doc.get("jax_backend") == "cpu"
+    assert len(doc.get("devices", [])) == 8
+    phases.reset()

@@ -83,7 +83,7 @@ def test_segmented_reassembly_and_ordering(monkeypatch):
 def test_stream_entry_routes_large_batches_to_segments(monkeypatch):
     seen = []
 
-    def fake_segmented(pks, msgs, sigs, chunk):
+    def fake_segmented(pks, msgs, sigs, chunk, t_entry=None):
         seen.append(len(pks))
         return np.ones(len(pks), bool)
 

@@ -21,7 +21,11 @@ Gating policy, by the bench's own unit conventions:
 * latency unit (s): lower is better — regression when
   new > old * (1 + threshold);
 * informational units (ratio, events, ms/height, error) and *_failed
-  markers: reported, never gated.
+  markers: reported, never gated — EXCEPT the cost-structure ratios named
+  in RATIO_GATED_LOWER_BETTER (currently the flagship's
+  verify_commit_10k_breakdown_pack_share), which gate lower-is-better at
+  the default threshold: the 7% -> 11.1% r04->r05 packing creep ran
+  ungated and this is the regression gate that would have caught it.
 
 The default threshold is deliberately loose (30%): the TPU relay's
 effective bandwidth swings hour to hour (PROFILE_r05), and a gate that
@@ -43,6 +47,11 @@ DEFAULT_THRESHOLD = 0.30
 HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s"}
 #: units gated as lower-is-better latency
 LOWER_BETTER_UNITS = {"s", "ms"}
+#: ratio-unit metrics gated lower-is-better DESPITE ratios defaulting to
+#: informational: the 10k flagship's packing share crept 7% -> 11.1%
+#: r04 -> r05 with nothing watching — cost-structure creep in these trips
+#: the gate like a latency regression would
+RATIO_GATED_LOWER_BETTER = {"verify_commit_10k_breakdown_pack_share"}
 
 
 def load_bench(path: str) -> Dict[str, dict]:
@@ -81,6 +90,11 @@ def load_bench(path: str) -> Dict[str, dict]:
 
 def gate_direction(metric: str, unit: str) -> Optional[str]:
     """'up' (higher better), 'down' (lower better), or None (not gated)."""
+    if metric in RATIO_GATED_LOWER_BETTER and unit == "ratio":
+        # checked before the generic _breakdown exclusion; the unit guard
+        # keeps the crashed-config convention (unit "error") flagging the
+        # row as errored instead of silently comparing garbage
+        return "down"
     if metric.endswith("_failed") or "_breakdown" in metric \
             or metric == "trace_summary":
         return None
@@ -109,7 +123,15 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
                "new": n["value"] if n else None,
                "direction": direction, "threshold": thr}
         if direction is None:
-            row["status"] = "info"
+            if o is not None and n is not None and \
+                    gate_direction(metric, n.get("unit", "")) is not None:
+                # the REVERSE unit flip: the OLD record errored (direction
+                # comes from its unit) while the new one gates — a crashed
+                # baseline must not silently un-gate the metric; flag it so
+                # the operator re-baselines instead of comparing garbage
+                row["status"] = "errored"
+            else:
+                row["status"] = "info"
         elif o is None:
             row["status"] = "new"
         elif n is None:
@@ -200,26 +222,63 @@ def self_test() -> int:
         _write(base, {"verify_commit_10k_sigs_per_sec": (157000.0, "sigs/s"),
                       "localnet_4node_tx_commit_latency_p50": (1.1, "s"),
                       "verify_commit_10k_breakdown_pack_share":
-                          (0.11, "ratio")})
-        # within the 30% window on throughput and latency: clean
+                          (0.11, "ratio"),
+                      "fast_sync_pipeline_breakdown_hash_store_share":
+                          (0.2, "ratio")})
+        # within the 30% window on throughput, latency, AND the gated
+        # pack-share ratio: clean (other breakdown ratios stay info even
+        # when they triple)
         ok = os.path.join(d, "ok.json")
         _write(ok, {"verify_commit_10k_sigs_per_sec": (140000.0, "sigs/s"),
                     "localnet_4node_tx_commit_latency_p50": (1.3, "s"),
                     "verify_commit_10k_breakdown_pack_share":
-                        (0.50, "ratio")})
+                        (0.13, "ratio"),
+                    "fast_sync_pipeline_breakdown_hash_store_share":
+                        (0.6, "ratio")})
         assert main([base, ok]) == 0
         # flagship degraded 60%: gate trips
         bad = os.path.join(d, "bad.json")
         _write(bad, {"verify_commit_10k_sigs_per_sec": (60000.0, "sigs/s"),
-                     "localnet_4node_tx_commit_latency_p50": (1.0, "s")})
+                     "localnet_4node_tx_commit_latency_p50": (1.0, "s"),
+                     "verify_commit_10k_breakdown_pack_share":
+                         (0.11, "ratio")})
         assert main([base, bad]) == 1
+        # the r04 -> r05 packing-share creep (0.07 -> 0.111, +59%), replayed
+        # synthetically: lower-is-better ratio gating trips exit 1
+        creep_old = os.path.join(d, "creep_old.json")
+        creep_new = os.path.join(d, "creep_new.json")
+        _write(creep_old, {"verify_commit_10k_breakdown_pack_share":
+                           (0.07, "ratio")})
+        _write(creep_new, {"verify_commit_10k_breakdown_pack_share":
+                           (0.111, "ratio")})
+        assert main([creep_old, creep_new]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(creep_old), load_bench(creep_new), {})}
+        assert rows["verify_commit_10k_breakdown_pack_share"][
+            "status"] == "regressed"
+        # ...and a loosened per-metric threshold un-trips it
+        assert main(["--threshold",
+                     "verify_commit_10k_breakdown_pack_share=0.9",
+                     creep_old, creep_new]) == 0
+        # an ERRORED BASELINE must not silently un-gate the metric for the
+        # next run (reverse unit flip: old=error, new=ratio)
+        err_base = os.path.join(d, "err_base.json")
+        _write(err_base, {"verify_commit_10k_breakdown_pack_share":
+                          (0.0, "error")})
+        assert main([err_base, creep_new]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(err_base), load_bench(creep_new), {})}
+        assert rows["verify_commit_10k_breakdown_pack_share"][
+            "status"] == "errored"
         rows = {r["metric"]: r for r in compare(
             load_bench(base), load_bench(bad), {})}
         assert rows["verify_commit_10k_sigs_per_sec"]["status"] == "regressed"
         # latency is gated lower-is-better
         slow = os.path.join(d, "slow.json")
         _write(slow, {"verify_commit_10k_sigs_per_sec": (157000.0, "sigs/s"),
-                      "localnet_4node_tx_commit_latency_p50": (2.0, "s")})
+                      "localnet_4node_tx_commit_latency_p50": (2.0, "s"),
+                      "verify_commit_10k_breakdown_pack_share":
+                          (0.11, "ratio")})
         assert main([base, slow]) == 1
         # a VANISHED gated metric is a failure, an informational one is not
         gone = os.path.join(d, "gone.json")
@@ -249,11 +308,13 @@ def self_test() -> int:
         assert load_bench(drv)[
             "verify_commit_10k_sigs_per_sec"]["value"] == 150000.0
         assert main([drv, ok]) == 0
-        # trajectory across 3 runs renders every gated metric
+        # trajectory across 3 runs renders every gated metric — including
+        # the now-gated pack share, but not the informational ratios
         table = trajectory([load_bench(p) for p in (base, ok, bad)],
                            ["r01", "r02", "r03"])
         assert "verify_commit_10k_sigs_per_sec" in table
-        assert "breakdown" not in table
+        assert "verify_commit_10k_breakdown_pack_share" in table
+        assert "fast_sync_pipeline_breakdown_hash_store_share" not in table
     finally:
         import shutil
 
