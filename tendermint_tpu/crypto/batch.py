@@ -156,9 +156,18 @@ class BatchVerifier:
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
         self._non_ed25519: List[Tuple[int, PubKey]] = []
+        self._columns = None
 
     def __len__(self) -> int:
         return len(self._pks)
+
+    def set_columns(self, columns) -> None:
+        """Columnar sign-bytes (crypto/signcols.SignColumns) aligned 1:1
+        with the rows added so far — a packing HINT for the device path
+        (skips per-segment structure re-discovery). Rows must reconstruct
+        byte-identically to the added msgs; verdicts cannot change either
+        way. Cleared by verify() with the rest of the batch."""
+        self._columns = columns
 
     def add(self, pub: PubKey, msg: bytes, sig: bytes) -> None:
         if not isinstance(pub, Ed25519PubKey):
@@ -172,7 +181,9 @@ class BatchVerifier:
         """-> (all_valid, per-item bool array). Resets the collected batch."""
         pks, msgs, sigs = self._pks, self._msgs, self._sigs
         non_ed = self._non_ed25519
+        columns = self._columns
         self._pks, self._msgs, self._sigs, self._non_ed25519 = [], [], [], []
+        self._columns = None
         n = len(pks)
         if n == 0:
             return True, np.zeros(0, dtype=bool)
@@ -230,11 +241,17 @@ class BatchVerifier:
                     if ed_pos:
                         # batch_verify_stream == batch_verify below one
                         # chunk; above, it scans fixed-size chunks inside
-                        # one device execution
+                        # one device execution. The columnar hint only
+                        # survives when it still aligns 1:1 with the rows
+                        # the kernel sees (no non-ed25519 holes)
+                        cols = (columns if columns is not None
+                                and len(ed_pos) == n
+                                and len(columns) == n else None)
                         ed_out = batch_verify_stream(
                             [pks[i] for i in ed_pos],
                             [msgs[i] for i in ed_pos],
-                            [sigs[i] for i in ed_pos])
+                            [sigs[i] for i in ed_pos],
+                            columns=cols)
                         out[ed_pos] = ed_out
                     # rare non-ed25519 keys verify on host, verdicts merged
                     # by index

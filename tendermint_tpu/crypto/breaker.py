@@ -23,6 +23,16 @@ Tuning: ``TMTPU_BREAKER_THRESHOLD`` (consecutive failures to trip,
 default 3), ``TMTPU_BREAKER_COOLDOWN_S`` (seconds OPEN before a probe,
 default 30). State + transitions export via CryptoMetrics when the node
 wires ``set_breaker_metrics``.
+
+Per-device lanes: the multi-device dispatcher
+(``crypto/ed25519_jax/multidevice.py``) keeps one breaker PER DEVICE via
+:func:`lane_breaker` (names ``device:<platform>:<id>``) so one sick chip
+degrades the pool to N-1 healthy lanes instead of collapsing the whole
+verification plane to host fallback. Lane knobs:
+``TMTPU_DEVICE_BREAKER_THRESHOLD`` / ``TMTPU_DEVICE_BREAKER_COOLDOWN_S``
+(falling back to the shared knobs above). Only when EVERY lane is sick
+does the failure surface to the caller — and then the shared
+``device_breaker`` takes over exactly as before.
 """
 
 from __future__ import annotations
@@ -121,6 +131,20 @@ class CircuitBreaker:
             self.stats["probes"] += 1
             return True
 
+    def peek(self) -> bool:
+        """Read-only: would :meth:`allow` admit a call right now? Unlike
+        ``allow`` this neither admits a half-open probe nor counts a
+        rejection — the multi-device planner uses it to pick healthy lanes
+        without consuming probe slots on lanes it may not dispatch to."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return self._clock() - self._opened_at >= self.cooldown_s
+            return not (self._probe_in_flight
+                        and self._clock() - self._probe_started_at
+                        < self.cooldown_s)
+
     def record_success(self) -> None:
         with self._lock:
             self._consecutive_failures = 0
@@ -184,6 +208,48 @@ class CircuitBreaker:
 
 #: the shared device-route breaker (BatchVerifier + vote micro-batcher)
 device_breaker = CircuitBreaker("device")
+
+
+# -- per-device lane breakers -------------------------------------------------
+
+#: device label ("tpu:3", "cpu:0") -> lane CircuitBreaker. Keyed by label,
+#: not device object: a rebuilt pool after reset_pool() reuses the same
+#: breaker state for the same physical chip.
+_LANE_BREAKERS: dict = {}
+_LANE_LOCK = threading.Lock()
+
+
+def lane_breaker(label: str) -> CircuitBreaker:
+    """The per-device breaker for one dispatch lane, created on first use.
+    Lane knobs (``TMTPU_DEVICE_BREAKER_THRESHOLD`` /
+    ``TMTPU_DEVICE_BREAKER_COOLDOWN_S``) are read at creation and fall back
+    to the shared breaker defaults."""
+    with _LANE_LOCK:
+        b = _LANE_BREAKERS.get(label)
+        if b is None:
+            thr = os.environ.get("TMTPU_DEVICE_BREAKER_THRESHOLD")
+            cd = os.environ.get("TMTPU_DEVICE_BREAKER_COOLDOWN_S")
+            b = CircuitBreaker(
+                f"device:{label}",
+                failure_threshold=int(thr) if thr else None,
+                cooldown_s=float(cd) if cd else None)
+            _LANE_BREAKERS[label] = b
+        return b
+
+
+def lane_breakers() -> dict:
+    """Snapshot of the live lane breakers (label -> CircuitBreaker)."""
+    with _LANE_LOCK:
+        return dict(_LANE_BREAKERS)
+
+
+def reset_lane_breakers() -> None:
+    """Reset every lane breaker and drop the registry (test fixtures; a
+    later lane_breaker() re-reads the env knobs)."""
+    with _LANE_LOCK:
+        for b in _LANE_BREAKERS.values():
+            b.reset()
+        _LANE_BREAKERS.clear()
 
 
 def classify_device_error(e: BaseException) -> str:

@@ -19,6 +19,7 @@ headroom), and the host recombines ``Σ psum_j · 2^8j`` in Python ints.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Sequence, Tuple
 
@@ -53,7 +54,22 @@ def make_mesh(n_devices: int) -> Mesh:
     return Mesh(np.array(devices[:n_devices]), axis_names=(AXIS,))
 
 
+# mesh identity -> jitted step: rebuilding shard_map + jax.jit per call
+# created a FRESH wrapper whose trace cache was empty, so every repeated
+# sharded call re-traced (and on a cold persistent cache re-compiled) the
+# whole verify kernel. Keyed by device ids + axis names — two Mesh objects
+# over the same devices share one compiled step.
+_STEP_CACHE: dict = {}
+_STEP_LOCK = threading.Lock()
+
+
 def _sharded_step(mesh: Mesh):
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    with _STEP_LOCK:
+        hit = _STEP_CACHE.get(key)
+        if hit is not None:
+            return hit
+
     try:
         from jax import shard_map
     except ImportError:  # older JAX
@@ -75,7 +91,11 @@ def _sharded_step(mesh: Mesh):
         sharded = shard_map(full_step, mesh=mesh, check_vma=False, **specs)
     except TypeError:  # older JAX spells it check_rep
         sharded = shard_map(full_step, mesh=mesh, check_rep=False, **specs)
-    return jax.jit(sharded)
+    step = jax.jit(sharded)
+    with _STEP_LOCK:
+        # a racing builder may have landed first; keep the winner so every
+        # caller shares one trace cache
+        return _STEP_CACHE.setdefault(key, step)
 
 
 def _power_limbs(powers: np.ndarray, pad: int, b: int) -> np.ndarray:

@@ -34,7 +34,7 @@ import os
 import threading
 import time
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -199,20 +199,35 @@ def _verify_sparse_stream_kernel(templates, diff_cols, diff_vals, mlen,
 # beyond this, dense blocks transfer less
 MAX_SPARSE_COLS = 96
 
+
+def _c_pad_bucket(c: int) -> int:
+    """Diff-column count padded to a bucket so the sparse kernel compiles
+    once per bucket, not per batch. ONE ladder for both the row-discovery
+    and the columnar pack paths — they must stay shape-compatible or
+    equivalent batches would compile twice."""
+    return next(cp for cp in (4, 8, 16, 32, 64, MAX_SPARSE_COLS)
+                if cp >= max(c, 1))
+
 # content-addressed device residency for the pubkey plane: commit
 # verification reuses the SAME validator keys for every block (fast-sync
 # replays thousands of commits against one set), so the (K, 32, B, 128)
 # key array is uploaded once and referenced by hash afterwards — host->
-# device bytes are the dominant cost of the batched verifier
+# device bytes are the dominant cost of the batched verifier. Keyed per
+# target device: each lane of the multi-device pool holds its own copy.
 _PK_DEVICE_CACHE: "dict" = {}
-_PK_CACHE_MAX = 8
+# sized for a few live validator sets RESIDENT ON EVERY LANE of an
+# 8-device pool (entries are per (content, device)); 8 was enough when
+# everything ran on chip 0
+_PK_CACHE_MAX = 32
 _PK_CACHE_LOCK = threading.Lock()
 
 
-def _device_cached(arr: np.ndarray):
+def _device_cached(arr: np.ndarray, device=None):
     import hashlib
 
-    key = (hashlib.sha256(arr.tobytes()).digest(), arr.shape, str(arr.dtype))
+    dev_key = None if device is None else (device.platform, device.id)
+    key = (hashlib.sha256(arr.tobytes()).digest(), arr.shape,
+           str(arr.dtype), dev_key)
     # the lock also dedupes concurrent identical puts from pipeline workers;
     # device_put itself is lazy (transfer happens at first use), so holding
     # it across the put is cheap
@@ -222,23 +237,88 @@ def _device_cached(arr: np.ndarray):
             return hit
         if len(_PK_DEVICE_CACHE) >= _PK_CACHE_MAX:
             _PK_DEVICE_CACHE.pop(next(iter(_PK_DEVICE_CACHE)))
-        buf = jax.device_put(arr)
+        buf = (jax.device_put(arr) if device is None
+               else jax.device_put(arr, device))
         _PK_DEVICE_CACHE[key] = buf
         return buf
 
 
-def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
-    """Pack a same-bucket batch into the sparse wire format, or return None
-    when the messages are too dissimilar for it to pay.
+class PackScratch:
+    """Per-worker reusable host packing buffers.
 
-    Each scan chunk gets its own template (its first row): a fast-sync
-    window concatenates several commits whose height/block_id bytes are
-    constant WITHIN a commit but differ across them — per-chunk templates
-    keep the diff-column union near the per-signature minimum.
+    The stream packer used to allocate (and page-fault) a fresh multi-MB
+    preimage matrix per segment — a measurable slice of the pack share the
+    bench gates (7% -> 11.1% r04->r05). Intermediates now reuse one
+    per-thread buffer per dtype, re-zeroed in place (memset, no fault
+    storm). ONLY intermediates: arrays handed across the device boundary
+    are freshly allocated every call, because jax may alias aligned host
+    buffers on the CPU backend and a reused buffer could be overwritten
+    while a previous segment's transfer is still in flight."""
 
-    Returns (device_args tuple for _verify_sparse_stream_kernel, ok mask).
-    """
+    __slots__ = ("_u8", "_u32")
+
+    def __init__(self):
+        self._u8 = None
+        self._u32 = None
+
+    def zeros_u8(self, shape) -> np.ndarray:
+        n = int(np.prod(shape))
+        if self._u8 is None or self._u8.size < n:
+            self._u8 = np.zeros(max(n, 1), dtype=np.uint8)
+        else:
+            self._u8[:n] = 0
+        return self._u8[:n].reshape(shape)
+
+    def empty_u32(self, shape) -> np.ndarray:
+        n = int(np.prod(shape))
+        if self._u32 is None or self._u32.size < n:
+            self._u32 = np.empty(max(n, 1), dtype=np.uint32)
+        return self._u32[:n].reshape(shape)
+
+
+_SCRATCH = threading.local()
+
+
+def _thread_scratch() -> PackScratch:
+    s = getattr(_SCRATCH, "scratch", None)
+    if s is None:
+        s = _SCRATCH.scratch = PackScratch()
+    return s
+
+
+def _sig_pk_arrays(pks, sigs):
+    """Shared host plumbing of the dense and sparse packers: length checks,
+    zero-substitution for malformed rows, the vectorized s < L compare.
+    Returns (r_arr (n,32), s_arr (n,32), pk_arr (n,32), ok (n,))."""
     n = len(pks)
+    pk_lens = np.array(list(map(len, pks)), dtype=np.int64)
+    sig_lens = np.array(list(map(len, sigs)), dtype=np.int64)
+    ok = (pk_lens == 32) & (sig_lens == 64)
+    if ok.all():
+        pk_l, sig_l = pks, sigs
+    else:
+        zpk, zsig = b"\x00" * 32, b"\x00" * 64
+        pk_l = [pk if o else zpk for pk, o in zip(pks, ok)]
+        sig_l = [sg if o else zsig for sg, o in zip(sigs, ok)]
+    sig_arr = np.frombuffer(b"".join(sig_l), dtype=np.uint8).reshape(n, 64)
+    r_arr = np.ascontiguousarray(sig_arr[:, :32])
+    s_arr = np.ascontiguousarray(sig_arr[:, 32:])
+    pk_arr = np.frombuffer(b"".join(pk_l), dtype=np.uint8).reshape(n, 32)
+    ok &= _s_lt_l(s_arr)
+    return r_arr, s_arr, pk_arr, ok
+
+
+def _sparse_from_rows(msgs, chunk: int):
+    """Discover the sparse structure of a row-materialized batch: join the
+    rows into one matrix and diff-scan against per-chunk templates. Each
+    scan chunk gets its own template (its first row): a fast-sync window
+    concatenates several commits whose height/block_id bytes are constant
+    WITHIN a commit but differ across them — per-chunk templates keep the
+    diff-column union near the per-signature minimum.
+
+    Returns (templates (k, MLEN) cols-zeroed, cols (C,), diff_vals (pad, C),
+    mlens (n,), k, pad) or None when the rows are too dissimilar."""
+    n = len(msgs)
     mlens = np.array(list(map(len, msgs)), dtype=np.int64)
     bucket = _nblk_bucket(int(mlens.max()))
     mlen_max = bucket * 128 - 64
@@ -267,30 +347,75 @@ def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
     if cols.shape[0] > MAX_SPARSE_COLS:
         return None
     templates[:, cols] = 0  # diff columns are fully per-item
-    # pad C to a bucket so the kernel compiles once per bucket, not per
-    # batch; padding duplicates column 0 (same value rewritten — harmless)
-    c_pad = next(c for c in (4, 8, 16, 32, 64, MAX_SPARSE_COLS)
-                 if c >= cols.shape[0])
+    # padding duplicates column 0 (same value rewritten — harmless)
+    c_pad = _c_pad_bucket(cols.shape[0])
     if c_pad > cols.shape[0]:
         cols = np.concatenate(
             [cols, np.zeros(c_pad - cols.shape[0], np.int32)])
     diff_vals = np.ascontiguousarray(arr[:, cols])       # (pad, C)
+    return templates, cols, diff_vals, mlens, k, pad
 
-    pk_lens = np.array(list(map(len, pks)), dtype=np.int64)
-    sig_lens = np.array(list(map(len, sigs)), dtype=np.int64)
-    ok = (pk_lens == 32) & (sig_lens == 64)
-    if ok.all():
-        pk_l, sig_l = pks, sigs
+
+def _sparse_from_columns(columns, chunk: int):
+    """The zero-copy fast path: the caller (a VerifyCommit* plane) already
+    knows the batch's columnar structure (crypto/signcols.SignColumns from
+    the canonical encoder), so the join + diff scan above is skipped
+    entirely — templates and diff values are sliced straight from the
+    columns object. Same return contract as :func:`_sparse_from_rows`."""
+    n = len(columns)
+    base_cols = columns.cols
+    if base_cols.shape[0] > MAX_SPARSE_COLS:
+        return None
+    bucket = _nblk_bucket(columns.mlen)
+    mlen_max = bucket * 128 - 64
+    k = -(-n // chunk)
+    pad = k * chunk
+    template = np.zeros(mlen_max, dtype=np.uint8)
+    template[:columns.mlen] = columns.template
+    c = base_cols.shape[0]
+    c_pad = _c_pad_bucket(c)
+    # duplicated pad columns repeat the first diff column (or column 0 for
+    # an all-identical batch) with the SAME value per row, so scatter write
+    # order cannot matter
+    pad_col = int(base_cols[0]) if c else 0
+    cols = np.full(c_pad, pad_col, dtype=np.int32)
+    cols[:c] = base_cols
+    orig_at_cols = template[cols].copy()  # pre-zeroing template bytes
+    diff_vals = np.empty((pad, c_pad), dtype=np.uint8)
+    if c:
+        diff_vals[:n, :c] = columns.vals
+        diff_vals[:n, c:] = columns.vals[:, :1]
     else:
-        zpk, zsig = b"\x00" * 32, b"\x00" * 64
-        pk_l = [pk if o else zpk for pk, o in zip(pks, ok)]
-        sig_l = [sg if o else zsig for sg, o in zip(sigs, ok)]
-    sig_arr = np.frombuffer(b"".join(sig_l), dtype=np.uint8).reshape(n, 64)
-    r_arr = np.ascontiguousarray(sig_arr[:, :32])
-    s_arr = np.ascontiguousarray(sig_arr[:, 32:])
-    pk_arr = np.frombuffer(b"".join(pk_l), dtype=np.uint8).reshape(n, 32)
-    ok &= _s_lt_l(s_arr)
+        diff_vals[:n] = orig_at_cols
+    diff_vals[n:] = orig_at_cols  # padded rows mirror the template
+    template[cols] = 0
+    templates = np.repeat(template[None, :], k, axis=0)
+    mlens = np.full(n, columns.mlen, dtype=np.int64)
+    return templates, cols, diff_vals, mlens, k, pad
 
+
+def prepare_sparse_stream(pks, msgs, sigs, chunk: int, columns=None,
+                          device=None):
+    """Pack a same-bucket batch into the sparse wire format, or return None
+    when the messages are too dissimilar for it to pay.
+
+    ``columns`` (crypto/signcols.SignColumns, aligned 1:1 with the batch)
+    short-circuits structure discovery; ``device`` commits every input to
+    an explicit device — the multi-device pool's per-lane placement.
+
+    Returns (device_args tuple for _verify_sparse_stream_kernel, ok mask).
+    """
+    n = len(pks)
+    built = None
+    if columns is not None and len(columns) == n:
+        built = _sparse_from_columns(columns, chunk)
+    if built is None:
+        built = _sparse_from_rows(msgs, chunk)
+    if built is None:
+        return None
+    templates, cols, diff_vals, mlens, k, pad = built
+
+    r_arr, s_arr, pk_arr, ok = _sig_pk_arrays(pks, sigs)
     if pad > n:
         r_arr = np.pad(r_arr, ((0, pad - n), (0, 0)))
         pk_arr = np.pad(pk_arr, ((0, pad - n), (0, 0)))
@@ -303,14 +428,16 @@ def prepare_sparse_stream(pks, msgs, sigs, chunk: int):
             a2d.reshape(k, chunk, width).transpose(0, 2, 1)
         ).reshape(k, width, b, LANE)
 
+    put = (jnp.asarray if device is None
+           else (lambda x: jax.device_put(x, device)))
     args = (
-        jnp.asarray(templates),
-        jnp.asarray(cols),
-        to_chunks(diff_vals, diff_vals.shape[1]),
-        mlens.astype(np.int32).reshape(k, b, LANE),
-        to_chunks(r_arr, 32),
-        _device_cached(to_chunks(pk_arr, 32)),
-        to_chunks(s_arr, 32),
+        put(templates),
+        put(cols),
+        put(to_chunks(diff_vals, diff_vals.shape[1])),
+        put(mlens.astype(np.int32).reshape(k, b, LANE)),
+        put(to_chunks(r_arr, 32)),
+        _device_cached(to_chunks(pk_arr, 32), device=device),
+        put(to_chunks(s_arr, 32)),
     )
     return args, ok
 
@@ -338,13 +465,20 @@ def _pad_to(n: int) -> int:
 
 
 def prepare_batch(
-    pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes],
+    rows: Optional[int] = None, min_nblk: Optional[int] = None,
+    scratch: Optional[PackScratch] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pack (pk, msg, sig) tuples into kernel inputs + host validity mask.
 
-    Returns (blocks (N, NBLK, 32) u32 BE, nblk (N,) i32, s_words (N, 8) u32,
+    Returns (blocks (R, NBLK, 32) u32 BE, nblk (R,) i32, s_words (R, 8) u32,
     ok (N,) bool). All numpy, vectorized except cheap per-item length/bytes
-    plumbing.
+    plumbing. ``rows`` (>= n) allocates padded zero rows up front and
+    ``min_nblk`` widens the block axis to a caller-chosen bucket, so the
+    stream packer no longer re-copies via np.pad; ``scratch`` routes the
+    big intermediates through a reusable per-worker buffer (the outputs
+    then ALIAS scratch memory — callers must consume them before the next
+    scratch-using call on the same thread and never hand them to jax).
     """
     if not (len(pks) == len(msgs) == len(sigs)):
         raise ValueError(
@@ -354,33 +488,25 @@ def prepare_batch(
     if n == 0:
         return (np.zeros((0, 1, 32), np.uint32), np.zeros(0, np.int32),
                 np.zeros((0, 8), np.uint32), np.zeros(0, bool))
-    pk_lens = np.array(list(map(len, pks)), dtype=np.int64)
-    sig_lens = np.array(list(map(len, sigs)), dtype=np.int64)
-    ok = (pk_lens == 32) & (sig_lens == 64)
-    if ok.all():
-        pk_l, sig_l = pks, sigs
-    else:
-        zpk, zsig = b"\x00" * 32, b"\x00" * 64
-        pk_l = [pk if o else zpk for pk, o in zip(pks, ok)]
-        sig_l = [sg if o else zsig for sg, o in zip(sigs, ok)]
-    sig_arr = np.frombuffer(b"".join(sig_l), dtype=np.uint8).reshape(n, 64)
-    r_arr = sig_arr[:, :32]
-    s_arr = np.ascontiguousarray(sig_arr[:, 32:])
-    pk_arr = np.frombuffer(b"".join(pk_l), dtype=np.uint8).reshape(n, 32)
-
-    ok &= _s_lt_l(s_arr)
+    out_rows = n if rows is None else rows
+    r_arr, s_arr, pk_arr, ok = _sig_pk_arrays(pks, sigs)
 
     # SHA-512 preimage blocks: R || A || M || 0x80 pad || 128-bit BE bitlen
     mlens = np.array(list(map(len, msgs)), dtype=np.int64)
     nblk = ((64 + mlens + 17 + 127) // 128).astype(np.int32)
     nblk_max = int(nblk.max())
-    blocks = np.zeros((n, nblk_max * 128), dtype=np.uint8)
-    blocks[:, :32] = r_arr
-    blocks[:, 32:64] = pk_arr
+    if min_nblk is not None and min_nblk > nblk_max:
+        nblk_max = min_nblk
+    if scratch is not None:
+        blocks = scratch.zeros_u8((out_rows, nblk_max * 128))
+    else:
+        blocks = np.zeros((out_rows, nblk_max * 128), dtype=np.uint8)
+    blocks[:n, :32] = r_arr
+    blocks[:n, 32:64] = pk_arr
     if n and mlens.max() == mlens.min():
         ml = int(mlens[0])
         if ml:
-            blocks[:, 64:64 + ml] = np.frombuffer(
+            blocks[:n, 64:64 + ml] = np.frombuffer(
                 b"".join(msgs), dtype=np.uint8).reshape(n, ml)
     elif int(mlens.sum()):
         # vectorized ragged scatter: flat destination index for every
@@ -392,16 +518,25 @@ def prepare_batch(
         within = np.arange(flat_src.shape[0], dtype=np.int64) - np.repeat(starts, mlens)
         dst = np.repeat(np.arange(n, dtype=np.int64) * width + 64, mlens) + within
         blocks.reshape(-1)[dst] = flat_src
-    rows = np.arange(n)
-    blocks[rows, 64 + mlens] = 0x80
+    rows_idx = np.arange(n)
+    blocks[rows_idx, 64 + mlens] = 0x80
     bitlen = ((64 + mlens) * 8).astype(np.uint64)
     last = nblk.astype(np.int64) * 128
     for k in range(8):
-        blocks[rows, last - 1 - k] = ((bitlen >> (8 * k)) & 0xFF).astype(np.uint8)
+        blocks[rows_idx, last - 1 - k] = ((bitlen >> (8 * k)) & 0xFF).astype(np.uint8)
 
     # big-endian u32 view + native cast = one vectorized byteswap pass
-    blocks_w = blocks.view(">u4").astype(np.uint32).reshape(n, nblk_max, 32)
-    s_words = np.ascontiguousarray(s_arr).view("<u4").astype(np.uint32)  # (n, 8)
+    if scratch is not None:
+        blocks_w = scratch.empty_u32((out_rows, nblk_max * 32))
+        np.copyto(blocks_w, blocks.view(">u4"))
+        blocks_w = blocks_w.reshape(out_rows, nblk_max, 32)
+    else:
+        blocks_w = blocks.view(">u4").astype(np.uint32).reshape(
+            out_rows, nblk_max, 32)
+    s_words = np.zeros((out_rows, 8), dtype=np.uint32)
+    s_words[:n] = s_arr.view("<u4")
+    if out_rows > n:
+        nblk = np.concatenate([nblk, np.zeros(out_rows - n, np.int32)])
     return blocks_w, nblk, s_words, ok
 
 
@@ -495,45 +630,53 @@ def batch_verify(
 
 def _pack_stream_dense(pks, msgs, sigs, chunk: int):
     """Dense stream packing: (kernel args (K, ..) tuple, ok mask). Shared
-    by _dispatch_stream's dense branch and tools/device_profile.py's
-    per-device scale cells (which device_put the same arrays onto an
-    explicit device)."""
+    by _dispatch_stream's dense branch, the multi-device lanes, and
+    tools/device_profile.py's per-device scale cells (which device_put the
+    same arrays onto an explicit device).
+
+    Intermediates ride the per-worker PackScratch (no fresh multi-MB
+    allocation per segment); the three returned arrays are freshly
+    allocated — they cross the device boundary, where jax may alias host
+    memory."""
     n = len(pks)
-    blocks_w, nblk, s_words, ok = prepare_batch(pks, msgs, sigs)
     bucket = _nblk_bucket(max(map(len, msgs)))
-    if blocks_w.shape[1] < bucket:
-        blocks_w = np.pad(blocks_w, ((0, 0), (0, bucket - blocks_w.shape[1]), (0, 0)))
     k = -(-n // chunk)
     pad = k * chunk
+    blocks_w, nblk, s_words, ok = prepare_batch(
+        pks, msgs, sigs, rows=pad, min_nblk=bucket,
+        scratch=_thread_scratch())
     nblk_max = blocks_w.shape[1]
-    if pad > n:
-        blocks_w = np.pad(blocks_w, ((0, pad - n), (0, 0), (0, 0)))
-        nblk = np.pad(nblk, (0, pad - n))
-        s_words = np.pad(s_words, ((0, pad - n), (0, 0)))
     b = chunk // LANE
-    blocks_d = np.ascontiguousarray(
-        blocks_w.reshape(k, chunk, nblk_max, 32).transpose(0, 2, 3, 1)
-    ).reshape(k, nblk_max, 32, b, LANE)
+    blocks_d = np.empty((k, nblk_max, 32, b, LANE), dtype=np.uint32)
+    np.copyto(blocks_d.reshape(k, nblk_max, 32, chunk),
+              blocks_w.reshape(k, chunk, nblk_max, 32).transpose(0, 2, 3, 1))
     nblk_d = nblk.reshape(k, b, LANE)
-    s_d = np.ascontiguousarray(
-        s_words.reshape(k, chunk, 8).transpose(0, 2, 1)
-    ).reshape(k, 8, b, LANE)
+    s_d = np.empty((k, 8, b, LANE), dtype=np.uint32)
+    np.copyto(s_d.reshape(k, 8, chunk),
+              s_words.reshape(k, chunk, 8).transpose(0, 2, 1))
     return (blocks_d, nblk_d, s_d), ok
 
 
-def _dispatch_stream(pks, msgs, sigs, chunk: int):
+def _dispatch_stream(pks, msgs, sigs, chunk: int, device=None, columns=None):
     """Pack one whole-chunk segment and dispatch it (sparse path if the
     messages are template-compressible, dense otherwise). Returns
     (device_verdict, ok_mask) WITHOUT fetching — the caller decides when to
     block, which is what lets the pipeline overlap host packing and
-    host->device transfer of segment i+1 with device compute of segment i."""
-    sparse = prepare_sparse_stream(pks, msgs, sigs, chunk)
+    host->device transfer of segment i+1 with device compute of segment i.
+
+    ``device`` commits the segment to an explicit device (a multi-device
+    pool lane); ``columns`` is the caller's columnar sign-bytes structure
+    (skips the sparse path's join + diff scan)."""
+    sparse = prepare_sparse_stream(pks, msgs, sigs, chunk, columns=columns,
+                                   device=device)
     if sparse is not None:
         args, ok = sparse
         phases.mark_pack_done()
         return _verify_sparse_stream_kernel(*args), ok
     args, ok = _pack_stream_dense(pks, msgs, sigs, chunk)
     phases.mark_pack_done()
+    if device is not None:
+        args = tuple(jax.device_put(a, device) for a in args)
     return _verify_stream_kernel(*args), ok
 
 
@@ -572,15 +715,24 @@ def _segment_sizes(k_total: int) -> list:
     return [base + (1 if i < extra else 0) for i in range(n_segs)]
 
 
-def _run_dispatch(rec, pks, msgs, sigs, chunk: int):
+def _run_dispatch(rec, pks, msgs, sigs, chunk: int, device=None,
+                  columns=None):
     """One segment's pack + async dispatch with phase stamps, on whatever
     thread runs it (segment 0 / single-dispatch: the caller; pipeline
-    segments: a worker). The active-segment slot lets _dispatch_stream
-    close the pack phase from inside without changing its signature."""
+    segments: a worker; multi-device: the lane's worker). The
+    active-segment slot lets _dispatch_stream close the pack phase from
+    inside without changing its signature."""
     rec.begin()
     prev = phases.set_active(rec)
     try:
-        dev, ok = _dispatch_stream(pks, msgs, sigs, chunk)
+        # kwargs only when set: _dispatch_stream is a test seam whose
+        # 4-positional-arg contract fakes rely on
+        kw = {}
+        if device is not None:
+            kw["device"] = device
+        if columns is not None:
+            kw["columns"] = columns
+        dev, ok = _dispatch_stream(pks, msgs, sigs, chunk, **kw)
     finally:
         phases.clear_active(prev)
     rec.dispatched()
@@ -588,9 +740,11 @@ def _run_dispatch(rec, pks, msgs, sigs, chunk: int):
 
 
 def _verify_segmented(pks, msgs, sigs, chunk: int,
-                      t_entry: float = None) -> np.ndarray:
+                      t_entry: float = None, columns=None) -> np.ndarray:
     n = len(pks)
     sizes = _segment_sizes(-(-n // chunk))
+    col_of = ((lambda a, b: columns.slice(a, b)) if columns is not None
+              else (lambda a, b: None))
     bounds, lo = [], 0
     for s in sizes:
         hi = min(lo + s * chunk, n)
@@ -618,10 +772,11 @@ def _verify_segmented(pks, msgs, sigs, chunk: int,
     # so the pipeline overlap is unaffected
     a0, b0 = bounds[0]
     futs = [_done_future(_run_dispatch(
-        recs[0], pks[a0:b0], msgs[a0:b0], sigs[a0:b0], chunk))]
+        recs[0], pks[a0:b0], msgs[a0:b0], sigs[a0:b0], chunk,
+        columns=col_of(a0, b0)))]
     futs += [
         pool.submit(_run_dispatch, recs[1], pks[a:b], msgs[a:b], sigs[a:b],
-                    chunk)
+                    chunk, columns=col_of(a, b))
         for a, b in bounds[1:2]
     ]
     out = np.zeros(n, dtype=bool)
@@ -633,7 +788,7 @@ def _verify_segmented(pks, msgs, sigs, chunk: int,
                 a2, b2 = bounds[i + 2]
                 futs.append(pool.submit(
                     _run_dispatch, recs[i + 2], pks[a2:b2], msgs[a2:b2],
-                    sigs[a2:b2], chunk))
+                    sigs[a2:b2], chunk, columns=col_of(a2, b2)))
             arr = np.asarray(dev)
             recs[i].fetched(wait_s=time.perf_counter() - t_wait0)
             out[a:b] = arr.reshape(-1)[:b - a] & ok
@@ -654,20 +809,42 @@ def _done_future(value):
     return f
 
 
+def _multidevice_pool():
+    """The process's MultiDeviceStream pool, or None (single device, pool
+    disabled via TMTPU_VERIFY_DEVICES, or the module failed to come up — a
+    broken pool must never take down the single-device path)."""
+    try:
+        from . import multidevice
+
+        return multidevice.pool()
+    except Exception:
+        return None
+
+
 def batch_verify_stream(
     pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes],
-    chunk: int = 2048,
+    chunk: int = 2048, columns=None,
 ) -> np.ndarray:
     """(N,) bool — verify a large batch as fixed-size chunks scanned inside
     as few device executions as possible: one per SEG_CHUNKS-chunk segment,
     double-buffered so segment i+1's host packing and transfer overlap
-    segment i's device compute (amortizes per-dispatch overhead)."""
+    segment i's device compute (amortizes per-dispatch overhead).
+
+    Batches big enough to amortize per-device dispatch overhead shard
+    round-robin across the multi-device pool (crypto/ed25519_jax/
+    multidevice.py) when one is available — per-device packing workers,
+    per-device circuit breakers, byte-identical verdicts either way.
+    ``columns`` (crypto/signcols.SignColumns aligned 1:1 with the batch)
+    lets VerifyCommit* callers hand the packer their sign-bytes structure
+    instead of having it re-discovered per segment."""
     t_entry = time.perf_counter()
     n = len(pks)
     if n == 0:
         return np.zeros(0, dtype=bool)
     if chunk % LANE:
         raise ValueError(f"chunk must be a multiple of {LANE}")
+    if columns is not None and len(columns) != n:
+        columns = None
     if n <= chunk:
         return batch_verify(pks, msgs, sigs)
     groups = _group_by_bucket(msgs)
@@ -679,10 +856,19 @@ def batch_verify_stream(
                                             [sigs[i] for i in idxs], chunk)
         return out
     if n >= SEG_MIN_SIGS and n > chunk:
+        md = _multidevice_pool()
+        if md is not None and md.engaged(n):
+            return md.verify(pks, msgs, sigs, chunk, columns=columns,
+                             t_entry=t_entry)
+        # the columns kwarg only when set: _verify_segmented is a test seam
+        # whose positional contract fakes rely on
+        if columns is not None:
+            return _verify_segmented(pks, msgs, sigs, chunk,
+                                     t_entry=t_entry, columns=columns)
         return _verify_segmented(pks, msgs, sigs, chunk, t_entry=t_entry)
     rec = phases.Segment(sigs=n, chunk=chunk, device=_device_label())
     rec.t0 = t_entry  # bucket grouping is critical-path pack cost
-    dev, ok = _run_dispatch(rec, pks, msgs, sigs, chunk)
+    dev, ok = _run_dispatch(rec, pks, msgs, sigs, chunk, columns=columns)
     try:
         t_w = time.perf_counter()
         arr = np.asarray(dev)

@@ -66,13 +66,13 @@ class NodeManifest:
             if p not in ("kill", "pause", "restart", "disconnect"):
                 raise ValueError(f"{self.name}: unknown perturbation {p!r}")
         if self.faults:
-            from ..libs.faults import KNOWN_SITES, FaultPlane
+            from ..libs.faults import KNOWN_SITES, FaultPlane, is_known_site
 
             try:  # fail at manifest load, not node boot
                 plane = FaultPlane().configure(self.faults, self.faults_seed)
             except ValueError as e:
                 raise ValueError(f"{self.name}: bad faults spec: {e}") from e
-            unknown = set(plane.counts()) - KNOWN_SITES
+            unknown = {s for s in plane.counts() if not is_known_site(s)}
             if unknown:
                 # a typo'd site arms nothing and the chaos run passes
                 # vacuously — reject it where the operator can see it

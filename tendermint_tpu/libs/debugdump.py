@@ -143,6 +143,22 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
             "recent_segments": phases.recent_segments(_DEVICE_SEGMENT_TAIL),
         }
         try:
+            # per-device lane health (multi-device pool): which chips are
+            # degraded, and the pool's reshard/error counters
+            from ..crypto.breaker import lane_breakers
+
+            doc["lane_breakers"] = {
+                label: {"state": b.state, "stats": dict(b.stats)}
+                for label, b in lane_breakers().items()}
+            md = sys.modules.get(
+                "tendermint_tpu.crypto.ed25519_jax.multidevice")
+            if md is not None and md._POOL is not None:
+                doc["multidevice_pool"] = {
+                    "lanes": [l.label for l in md._POOL.lanes],
+                    "stats": dict(md._POOL.stats)}
+        except Exception as e:
+            doc["lane_breakers"] = f"unavailable: {e}"
+        try:
             from . import compilecache
 
             doc["compile_cache"] = compilecache.status()

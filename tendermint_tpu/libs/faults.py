@@ -35,6 +35,8 @@ and the consensus loop alike.
 Known sites (the catalog; see README "Fault injection & chaos testing"):
 
 * ``device.batch_verify`` — BatchVerifier's device dispatch (crypto/batch.py)
+* ``device.lane.<label>`` — ONE multi-device pool lane (site family, e.g.
+                            ``device.lane.tpu:3``; multidevice.py)
 * ``device.vote_flush``   — vote micro-batcher device flush (vote_batcher.py)
 * ``wal.fsync``           — consensus WAL fsync (consensus/wal.py)
 * ``db.write_batch``      — KV write batches: BufferedDB window flush and
@@ -86,6 +88,18 @@ KNOWN_SITES = frozenset({
     "statesync.lying_chunk",
     "blocksync.bad_block",
 })
+
+#: site-name prefixes that are known as a FAMILY: the multi-device
+#: dispatcher consults one site per device lane
+#: (``device.lane.<platform>:<id>``, e.g. ``device.lane.tpu:3``), so a
+#: chaos run can arm exactly one chip and watch the pool degrade to the
+#: healthy peers. Exact names can't be enumerated — device topology is a
+#: runtime fact.
+KNOWN_SITE_PREFIXES = ("device.lane.",)
+
+
+def is_known_site(name: str) -> bool:
+    return name in KNOWN_SITES or name.startswith(KNOWN_SITE_PREFIXES)
 
 logger = logging.getLogger("tmtpu.faults")
 
@@ -211,7 +225,7 @@ class FaultPlane:
         spec = environ.get(ENV_SPEC, "")
         if spec:
             self.configure(spec, int(environ.get(ENV_SEED, "0") or "0"))
-            unknown = set(self._sites) - KNOWN_SITES
+            unknown = {s for s in self._sites if not is_known_site(s)}
             if unknown:
                 logger.warning(
                     "%s arms site(s) no production code consults: %s — "

@@ -15,7 +15,11 @@ from .. import crypto
 from ..crypto import merkle
 from ..libs import protowire as pw
 from .basic import BlockID, BlockIDFlag, PartSetHeader, SignedMsgType, ZERO_TIME_NS
-from .canonical import vote_sign_bytes, vote_sign_bytes_batch
+from .canonical import (
+    vote_sign_bytes,
+    vote_sign_bytes_batch,
+    vote_sign_bytes_columns_batch,
+)
 from .tx import txs_hash
 from .vote import MAX_SIGNATURE_SIZE, Vote
 
@@ -280,6 +284,10 @@ class CommitSig:
         return cs
 
 
+#: memo sentinel: vote_sign_bytes_columns legitimately caches None
+_NO_COLUMNS = object()
+
+
 @dataclass
 class Commit:
     height: int
@@ -323,6 +331,28 @@ class Commit:
         hit = cache.get(chain_id)
         if hit is None:
             hit = vote_sign_bytes_batch(
+                chain_id,
+                SignedMsgType.PRECOMMIT,
+                self.height,
+                self.round,
+                [cs.block_id(self.block_id) for cs in self.signatures],
+                [cs.timestamp_ns for cs in self.signatures],
+            )
+            cache[chain_id] = hit
+        return hit
+
+    def vote_sign_bytes_columns(self, chain_id: str):
+        """Columnar sign-bytes (crypto.signcols.SignColumns) for the whole
+        commit, memoized per chain_id like vote_sign_bytes_all — or None
+        when the rows are not structurally uniform (nil votes mixed in,
+        ragged timestamp encodings). The batched verifiers hand this to the
+        device pack path so it never re-diffs what the encoder already
+        knew; row i reconstructs byte-identically to
+        vote_sign_bytes_all(chain_id)[i]."""
+        cache = self.__dict__.setdefault("_sbc_cache", {})
+        hit = cache.get(chain_id, _NO_COLUMNS)
+        if hit is _NO_COLUMNS:
+            hit = vote_sign_bytes_columns_batch(
                 chain_id,
                 SignedMsgType.PRECOMMIT,
                 self.height,

@@ -96,6 +96,78 @@ def vote_sign_bytes_batch(
     return out
 
 
+def vote_sign_bytes_columns_batch(
+    chain_id: str,
+    vote_type: SignedMsgType,
+    height: int,
+    round_: int,
+    block_ids,
+    timestamps_ns,
+):
+    """Columnar form of :func:`vote_sign_bytes_batch`: a SignColumns
+    (template + varying byte positions + per-row values) built straight
+    from the encoder's cached fragments, or ``None`` when the rows are not
+    structurally uniform (mixed block ids — nil votes — or timestamp
+    encodings of different byte lengths, where rows shift relative to each
+    other and a shared template does not exist).
+
+    The point is what it does NOT do: no per-row bytes objects, no
+    O(n*mlen) join + diff scan downstream — the device pack path
+    (prepare_sparse_stream) consumes the arrays directly. Row
+    reconstruction is byte-identical to vote_sign_bytes_batch
+    (differential tests in tests/test_multidevice_stream.py)."""
+    import numpy as np
+
+    from ..crypto.signcols import SignColumns
+
+    n = len(timestamps_ns)
+    if n == 0:
+        return None
+    first_bid = block_ids[0]
+    for bid in block_ids:
+        if bid != first_bid:
+            return None  # nil rows mix in: f4 omitted, rows shift
+    w = pw.Writer()
+    w.varint(1, int(vote_type))
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    prefix = w.finish()
+    body = canonical_block_id_bytes(first_bid)
+    ev = pw.encode_varint
+    f4 = b"" if body is None else b"\x22" + ev(len(body)) + body
+    sw = pw.Writer()
+    sw.string(6, chain_id)
+    suffix = sw.finish()
+
+    # per-row timestamp field 5 (same fragment layout as
+    # vote_sign_bytes_batch: cached seconds varint + per-row nanos)
+    sec_cache: dict = {}
+    frags = []
+    flen = None
+    for ns in timestamps_ns:
+        seconds, nanos = divmod(ns, 1_000_000_000)
+        ts = sec_cache.get(seconds)
+        if ts is None:
+            ts = b"\x08" + ev(seconds) if seconds else b""
+            sec_cache[seconds] = ts
+        if nanos:
+            ts = ts + b"\x10" + ev(nanos)
+        f5 = b"\x2a" + ev(len(ts)) + ts
+        if flen is None:
+            flen = len(f5)
+        elif len(f5) != flen:
+            return None  # ragged timestamps: no shared template
+        frags.append(f5)
+
+    body_len = len(prefix) + len(f4) + flen + len(suffix)
+    head = ev(body_len) + prefix + f4
+    template = np.frombuffer(head + frags[0] + suffix, dtype=np.uint8)
+    frag_arr = np.frombuffer(b"".join(frags), dtype=np.uint8).reshape(n, flen)
+    diff = (frag_arr != frag_arr[0]).any(axis=0)
+    cols = (np.nonzero(diff)[0] + len(head)).astype(np.int32)
+    return SignColumns(template, cols, frag_arr[:, diff])
+
+
 def proposal_sign_bytes(
     chain_id: str,
     height: int,

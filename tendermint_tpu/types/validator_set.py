@@ -456,6 +456,14 @@ class ValidatorSet:
             pk = pubkeys[pos] if pubkeys is not None else self.validators[idx].pub_key
             msg = sb[idx] if sb is not None else commit.vote_sign_bytes(chain_id, idx)
             bv.add(pk, msg, commit.signatures[idx].signature)
+        if sb is not None:
+            # columnar fast path: hand the device packer the commit's
+            # sign-bytes structure (template + varying timestamp columns)
+            # so it skips the per-segment join + diff re-discovery. None
+            # for structurally non-uniform commits (nil votes mixed in).
+            cols = commit.vote_sign_bytes_columns(chain_id)
+            if cols is not None:
+                bv.set_columns(cols.subset(idxs))
         _, per_item = bv.verify()
         return [bool(b) for b in per_item]
 

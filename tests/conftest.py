@@ -25,22 +25,32 @@ def _reset_fault_state():
     """Fail-point counters, armed fault sites, and breaker state are
     process-global by design (subprocess nodes arm them from env) — reset
     around every test so one test's chaos can't leak into the next."""
+    import sys
+
     from tendermint_tpu.crypto import phases
-    from tendermint_tpu.crypto.breaker import device_breaker
+    from tendermint_tpu.crypto.breaker import (
+        device_breaker,
+        reset_lane_breakers,
+    )
     from tendermint_tpu.libs import fail
     from tendermint_tpu.libs.faults import faults
 
-    fail.reset()
-    faults.reset()
-    device_breaker.reset()
-    phases.reset()
-    phases.set_device_metrics(None)
+    def _reset_all():
+        fail.reset()
+        faults.reset()
+        device_breaker.reset()
+        reset_lane_breakers()
+        phases.reset()
+        phases.set_device_metrics(None)
+        # only if a test built the multi-device pool: tear it down so the
+        # next test re-resolves it (and re-reads its env knobs)
+        md = sys.modules.get("tendermint_tpu.crypto.ed25519_jax.multidevice")
+        if md is not None:
+            md.reset_pool()
+
+    _reset_all()
     yield
-    fail.reset()
-    faults.reset()
-    device_breaker.reset()
-    phases.reset()
-    phases.set_device_metrics(None)
+    _reset_all()
 
 
 def pytest_collection_modifyitems(config, items):

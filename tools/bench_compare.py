@@ -220,6 +220,8 @@ def self_test() -> int:
     try:
         base = os.path.join(d, "old.json")
         _write(base, {"verify_commit_10k_sigs_per_sec": (157000.0, "sigs/s"),
+                      "verify_commit_10k_multichip_sigs_per_sec":
+                          (500000.0, "sigs/s"),
                       "localnet_4node_tx_commit_latency_p50": (1.1, "s"),
                       "verify_commit_10k_breakdown_pack_share":
                           (0.11, "ratio"),
@@ -230,19 +232,29 @@ def self_test() -> int:
         # when they triple)
         ok = os.path.join(d, "ok.json")
         _write(ok, {"verify_commit_10k_sigs_per_sec": (140000.0, "sigs/s"),
+                    "verify_commit_10k_multichip_sigs_per_sec":
+                        (480000.0, "sigs/s"),
                     "localnet_4node_tx_commit_latency_p50": (1.3, "s"),
                     "verify_commit_10k_breakdown_pack_share":
                         (0.13, "ratio"),
                     "fast_sync_pipeline_breakdown_hash_store_share":
                         (0.6, "ratio")})
         assert main([base, ok]) == 0
-        # flagship degraded 60%: gate trips
+        # flagship degraded 60%: gate trips — and the MULTICHIP flagship
+        # is gated higher-better exactly like it (a silently-collapsed
+        # device pool reads as a regression, not noise)
         bad = os.path.join(d, "bad.json")
         _write(bad, {"verify_commit_10k_sigs_per_sec": (60000.0, "sigs/s"),
+                     "verify_commit_10k_multichip_sigs_per_sec":
+                         (150000.0, "sigs/s"),
                      "localnet_4node_tx_commit_latency_p50": (1.0, "s"),
                      "verify_commit_10k_breakdown_pack_share":
                          (0.11, "ratio")})
         assert main([base, bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(base), load_bench(bad), {})}
+        assert rows["verify_commit_10k_multichip_sigs_per_sec"][
+            "status"] == "regressed"
         # the r04 -> r05 packing-share creep (0.07 -> 0.111, +59%), replayed
         # synthetically: lower-is-better ratio gating trips exit 1
         creep_old = os.path.join(d, "creep_old.json")
@@ -295,6 +307,8 @@ def self_test() -> int:
         assert rows["verify_commit_10k_sigs_per_sec"]["status"] == "errored"
         # per-metric threshold override loosens the gate
         assert main(["--threshold", "verify_commit_10k_sigs_per_sec=0.9",
+                     "--threshold",
+                     "verify_commit_10k_multichip_sigs_per_sec=0.9",
                      "--threshold",
                      "localnet_4node_tx_commit_latency_p50=2.0",
                      base, bad]) == 0

@@ -6,6 +6,10 @@ deterministic seed, asserting the survival property that site promises:
 
 * device.batch_verify — injected device errors: host fallback keeps
   verdicts byte-identical, breaker opens and re-closes
+* device.lane         — ONE device label armed (device.lane.<label>): the
+  multi-device pool degrades to the healthy lanes, re-shards the sick
+  lane's segments with zero dropped signatures, verdicts byte-identical;
+  a healed lane rejoins
 * device.vote_flush   — same through the vote micro-batcher (futures all
   resolve correctly, no device error ever surfaces)
 * wal.fsync           — fsync EIO (policy=raise here): records past the
@@ -60,6 +64,7 @@ DEFAULT_SEEDS = (1, 2, 3)
 #: cell name -> slow?
 SITES = {
     "device.batch_verify": False,
+    "device.lane": False,
     "device.vote_flush": False,
     "wal.fsync": False,
     "db.write_batch": False,
@@ -136,6 +141,66 @@ def cell_device_batch_verify(seed: int) -> None:
     ok, _ = bv.verify()  # half-open probe (or already-closed device route)
     assert ok
     assert device_breaker.state == CLOSED, device_breaker.state
+
+
+def cell_device_lane(seed: int) -> None:
+    """One sick chip in the multi-device pool: the per-lane fault site
+    (``device.lane.<label>``) is armed against EXACTLY ONE device label,
+    its breaker opens, the pool degrades to the healthy peers with
+    byte-identical verdicts and zero dropped signatures, and a healed lane
+    rejoins. Shape-identical stub kernels (tools/device_profile) keep this
+    off the multi-minute per-ordinal CPU compiles of the real kernel."""
+    import os
+
+    import numpy as np
+
+    os.environ["TMTPU_DEVICE_BREAKER_THRESHOLD"] = "2"
+    os.environ["TMTPU_DEVICE_BREAKER_COOLDOWN_S"] = "0.05"
+
+    import device_profile as DP
+    import jax
+
+    from tendermint_tpu.crypto.breaker import (
+        CLOSED,
+        OPEN,
+        lane_breaker,
+        reset_lane_breakers,
+    )
+    from tendermint_tpu.crypto.ed25519_jax import multidevice as MD
+    from tendermint_tpu.crypto.ed25519_jax import verify as V
+    from tendermint_tpu.libs.faults import faults
+
+    restore = DP.install_stub_kernels(V)
+    try:
+        rng = np.random.default_rng(seed)
+        n = 1280
+        pks = [rng.bytes(32) for _ in range(n)]
+        msgs = [rng.bytes(120) for _ in range(n)]
+        sigs = [rng.bytes(63) + b"\x00" for _ in range(n)]
+        want = V._verify_segmented(pks, msgs, sigs, V.LANE)
+        devs = jax.devices()[:4]
+        sick = f"{devs[1].platform}:{devs[1].id}"
+        faults.configure(f"device.lane.{sick}", seed=seed)  # always fires
+        pool = MD.MultiDeviceStream(devices=devs, min_sigs=0)
+        for round_ in range(4):
+            got = pool.verify(pks, msgs, sigs, chunk=V.LANE)
+            assert (got == want).all(), \
+                f"round {round_}: verdicts diverged under lane injection"
+        assert faults.fires(f"device.lane.{sick}") >= 2, "site never fired"
+        assert lane_breaker(sick).state == OPEN, lane_breaker(sick).state
+        assert pool.stats["resharded_segments"] >= 1
+        # heal: disarm + clear breakers — the lane rejoins and verdicts
+        # stay identical
+        faults.reset()
+        reset_lane_breakers()
+        pool2 = MD.MultiDeviceStream(devices=devs, min_sigs=0)
+        got = pool2.verify(pks, msgs, sigs, chunk=V.LANE)
+        assert (got == want).all()
+        assert lane_breaker(sick).state == CLOSED
+        pool.shutdown()
+        pool2.shutdown()
+    finally:
+        restore()
 
 
 def cell_device_vote_flush(seed: int) -> None:
@@ -555,6 +620,7 @@ def cell_blocksync_bad_block(seed: int) -> None:
 
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
+    "device.lane": cell_device_lane,
     "device.vote_flush": cell_device_vote_flush,
     "wal.fsync": cell_wal_fsync,
     "db.write_batch": cell_db_write_batch,
