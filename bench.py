@@ -11,6 +11,8 @@ Configs (BASELINE.json):
   3  VerifyCommitLight+Trusting over a 1000-validator header chain
   4  4-node localnet (kvstore), consensus end-to-end blocks/min
   5  fast-sync windowed replay @ 1000 validators
+  ingest  open-loop broadcast_tx load on the 4-node localnet: sustained
+       committed txs/s + p99 broadcast->commit latency (tools/loadtime.py)
   multichip  devices x chunk scaling table (device_profile scale)
   10k  sustained VerifyCommit @ 10,240 validators (flagship, last) plus
        the multichip flagship through the multi-device dispatcher
@@ -818,6 +820,130 @@ def bench_localnet():
               "ms/height", 0.0, **skew)
 
 
+def bench_ingest():
+    """Config ingest: open-loop broadcast_tx load against the 4-node
+    localnet (tools/loadtime.py) — the ROADMAP ingestion plane's gate.
+    Send times are pre-planned on a fixed-rate grid (coordinated omission
+    cannot hide stalls); per-tx latency is recovered from committed blocks
+    via the embedded planned-send timestamp, cross-checked against the
+    nodes' own /tx_timeline lifecycle records; mempool/RPC ingestion
+    series ride along from node0's /metrics. Emits two gated rows:
+    localnet_4node_ingest_txs_per_sec (higher-better) and
+    localnet_4node_ingest_commit_latency_p99_s (lower-better)."""
+    import asyncio
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    root = tempfile.mkdtemp(prefix="bench-ingest-")
+    port0 = 28856  # clear of config 4's 28656 block when running "all"
+    rate, duration, size, clients = 25.0, 12.0, 96, 4
+    endpoint = f"http://127.0.0.1:{port0 + 1}"
+    metrics_endpoint = f"http://127.0.0.1:{port0 + 8}/metrics"
+
+    def rpc(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+    def emit_error(err: str) -> None:
+        # the crashed-config unit convention: both gated rows must read
+        # as ERRORED in bench_compare, never as silent absence
+        for metric in ("localnet_4node_ingest_txs_per_sec",
+                       "localnet_4node_ingest_commit_latency_p99_s"):
+            _emit(metric, 0.0, "error", 0.0, error=err)
+
+    procs = []
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        subprocess.run(
+            ["python", "-m", "tendermint_tpu.cmd", "testnet", "--v", "4",
+             "--output-dir", root, "--chain-id", "bench-ingest",
+             "--starting-port", str(port0), "--prometheus"],
+            check=True, capture_output=True, timeout=120, env=env)
+        for i in range(4):
+            procs.append(subprocess.Popen(
+                ["python", "-m", "tendermint_tpu.cmd", "--home",
+                 f"{root}/node{i}", "start", "--log-level", "error"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 120
+        h0 = None
+        while time.time() < deadline:
+            try:
+                h0 = int(rpc(port0 + 1, "status")
+                         ["result"]["sync_info"]["latest_block_height"])
+                if h0 >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert h0 is not None and h0 >= 2, "localnet failed to start"
+
+        lt = _tools_mod("loadtime")
+        load_stats = asyncio.run(lt.open_loop_load(
+            endpoint, rate=rate, duration=duration, size=size,
+            clients=clients))
+        # settle: let the tail of the offered load commit before reading
+        # the chain back (bounded — a wedged net must not hang the bench)
+        settle_deadline = time.time() + 30
+        while time.time() < settle_deadline:
+            try:
+                pending = int(rpc(port0 + 1, "num_unconfirmed_txs")
+                              ["result"]["n_txs"])
+                if pending == 0:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        doc = lt.report_doc(endpoint, metrics_endpoint=metrics_endpoint)
+        if not doc.get("txs"):
+            emit_error("no harness txs found in committed blocks")
+            return
+        tlr = doc.get("tx_timeline", {})
+        mtx = doc.get("metrics", {})
+        _emit("localnet_4node_ingest_txs_per_sec", doc["txs_per_sec"],
+              "txs/s", doc["txs_per_sec"] / rate,
+              offered_rate=rate, duration_s=duration, clients=clients,
+              planned=load_stats["planned"],
+              accepted=load_stats["accepted"],
+              rejected=load_stats["rejected"],
+              send_errors=load_stats["errors"],
+              committed=doc["txs"],
+              max_sched_lag_s=round(load_stats["max_sched_lag_s"], 4),
+              mempool_admitted=mtx.get(
+                  "tendermint_mempool_admitted_txs_total"),
+              rpc_broadcast_ok=mtx.get(
+                  'tendermint_rpc_request_seconds_count'
+                  '{endpoint="broadcast_tx_sync",outcome="ok"}'))
+        # the acceptance probe: at least one sampled tx's timeline record
+        # must carry the full rpc_received → committed stage chain
+        _emit("localnet_4node_ingest_commit_latency_p99_s",
+              doc["latency_s"]["p99"], "s", 0.0,
+              latency_s=doc["latency_s"],
+              node_commit_latency_s=tlr.get("node_commit_latency_s"),
+              timeline_complete_records=tlr.get(
+                  "complete_rpc_to_commit_records"),
+              timeline_stage_counts=tlr.get("stage_counts"),
+              timeline_sampled_sealed=tlr.get("sealed_total"))
+    except Exception as e:
+        emit_error(f"{type(e).__name__}: {e}")
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_verify_commit_10k():
     """FLAGSHIP (north star): VerifyCommit at 10,240 validators — the scale
     BASELINE.json names (≥15x target vs the host scalar loop, reference
@@ -1031,6 +1157,7 @@ CONFIGS = {
     "3": bench_light_chain_1000,
     "4": bench_localnet,
     "5": bench_fast_sync_replay,
+    "ingest": bench_ingest,
     "multichip": bench_multichip_scale,
     "10k": bench_verify_commit_10k,
 }
@@ -1077,7 +1204,8 @@ if __name__ == "__main__":
             # flagship last: the driver records the final line. The remote
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
-            for key in ("2", "3", "4", "5", "1", "multichip", "10k"):
+            for key in ("2", "3", "4", "ingest", "5", "1", "multichip",
+                        "10k"):
                 for attempt in (1, 2):
                     try:
                         with _tracer.span(f"config_{key}"):

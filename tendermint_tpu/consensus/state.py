@@ -661,7 +661,20 @@ class ConsensusState:
         self.send_internal(ProposalMessage(proposal))
         for i in range(block_parts.total):
             self.send_internal(BlockPartMessage(rs.height, round_, block_parts.get_part(i)))
+        # ingestion-plane lifecycle: the proposer stamps proposal_included
+        # at creation (followers stamp at complete-proposal decode)
+        tl = self._txlife()
+        if tl is not None and tl.tracking():
+            for tx in block.data.txs:
+                tl.mark_tx(tx, "proposal_included", height=height)
         logger.info("signed proposal %d/%d", height, round_)
+
+    def _txlife(self):
+        """The per-node tx lifecycle tracker (libs/txlife.py), reached
+        through the mempool it is wired onto (NoOpMempool and bare test
+        mempools simply have none)."""
+        return getattr(getattr(self.block_exec, "mempool", None),
+                       "txlife", None)
 
     def _is_proposal_complete(self) -> bool:
         """(state.go isProposalComplete)"""
@@ -897,6 +910,14 @@ class ConsensusState:
         # emits the per-stage trace spans (consensus/timeline.py)
         self.timeline.mark(height, rs.commit_round, "commit_finalized")
 
+        # seal sampled tx lifecycles at the consensus commit point; the
+        # mempool.update() mark inside apply_block is the fallback for
+        # blocks applied off the consensus path (fast sync)
+        tl = self._txlife()
+        if tl is not None and tl.tracking():
+            for tx in block.data.txs:
+                tl.mark_tx(tx, "committed", height=height)
+
         if self.metrics is not None:
             self._record_commit_metrics(block)
 
@@ -972,6 +993,13 @@ class ConsensusState:
             logger.info("received complete proposal block height=%d hash=%s",
                         rs.proposal_block.header.height,
                         (rs.proposal_block.hash() or b"").hex()[:12])
+            # followers stamp proposal_included when the block decodes —
+            # the earliest point this node can attribute txs to a height
+            tl = self._txlife()
+            if tl is not None and tl.tracking():
+                for tx in rs.proposal_block.data.txs:
+                    tl.mark_tx(tx, "proposal_included",
+                               height=rs.proposal_block.header.height)
             if self.event_bus:
                 self.event_bus.publish_event_complete_proposal(
                     EventDataCompleteProposal(

@@ -181,6 +181,20 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
     except Exception:
         traceback.print_exc(file=sys.stderr)
 
+    # per-tx lifecycle tail (libs/txlife.py): the ingestion plane's view of
+    # the moments before the wedge — which stage sampled txs stalled in,
+    # how deep the active map ran, and the last sealed broadcast→commit
+    # records with their stage decompositions
+    try:
+        import json
+
+        tl = getattr(getattr(node, "mempool", None), "txlife", None)
+        if tl is not None:
+            with open(os.path.join(out_dir, "txlife.json"), "w") as f:
+                json.dump(tl.snapshot(_TIMELINE_TAIL_HEIGHTS), f, indent=1)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
     # statesync progress (statesync/syncer.py progress()): a bootstrap that
     # wedged mid-restore must be diagnosable post-mortem — which snapshot,
     # how many chunks landed, and which peers were struck/banned

@@ -356,17 +356,61 @@ class ConsensusMetrics:
 
 
 class MempoolMetrics:
-    """(mempool/metrics.go)"""
+    """(mempool/metrics.go — grown the ingestion-plane series a
+    high-traffic mempool needs: depth in txs AND bytes on every mutation
+    path, admission/rejection/eviction taxonomies, CheckTx/recheck
+    latency distributions, and the per-tx lifecycle histograms fed by
+    libs/txlife.py)."""
+
+    #: CheckTx is an in-proc app call (~us) but socket/grpc apps reach ms
+    CHECKTX_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                       0.01, 0.025, 0.05, 0.1, 0.25)
+    #: broadcast→commit spans one to several block intervals
+    COMMIT_LATENCY_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                              30.0, 60.0)
 
     def __init__(self, reg: Registry):
-        self.size = reg.gauge("mempool", "size", "Number of uncommitted txs.")
-        self.tx_size_bytes = reg.histogram(
+        g, c, h = reg.gauge, reg.counter, reg.histogram
+        self.size = g("mempool", "size", "Number of uncommitted txs.")
+        self.size_bytes = g("mempool", "size_bytes",
+                            "Total bytes of uncommitted txs (depth-bytes).")
+        self.tx_size_bytes = h(
             "mempool", "tx_size_bytes", "Tx sizes in bytes.",
             buckets=(32, 128, 512, 2048, 8192, 32768, 131072))
-        self.failed_txs = reg.counter("mempool", "failed_txs",
-                                      "Txs that failed CheckTx.")
-        self.recheck_times = reg.counter("mempool", "recheck_times",
-                                         "Times txs were rechecked.")
+        self.failed_txs = c(
+            "mempool", "failed_txs",
+            "Txs rejected before admission, by reason "
+            "(cache-dup, app-reject, full, too-large).", ["reason"])
+        self.admitted_txs_total = c(
+            "mempool", "admitted_txs_total",
+            "Txs that passed CheckTx and entered the mempool.")
+        self.evicted_txs_total = c(
+            "mempool", "evicted_txs_total",
+            "Admitted txs removed without committing, by reason "
+            "(recheck-failed, flush).", ["reason"])
+        self.recheck_times = c("mempool", "recheck_times",
+                               "Times txs were rechecked.")
+        self.checktx_latency_seconds = h(
+            "mempool", "checktx_latency_seconds",
+            "App CheckTx latency for first-time admission checks.",
+            buckets=self.CHECKTX_BUCKETS)
+        self.recheck_latency_seconds = h(
+            "mempool", "recheck_latency_seconds",
+            "App CheckTx latency for post-block rechecks.",
+            buckets=self.CHECKTX_BUCKETS)
+        # -- per-tx lifecycle (libs/txlife.py) ---------------------------
+        self.tx_stage_seconds = h(
+            "mempool", "tx_stage_seconds",
+            "Seconds from the previous lifecycle stage stamp to this one "
+            "(rpc_received, checktx_done, mempool_admitted, first_gossip, "
+            "proposal_included, committed, rechecked).", ["stage"],
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0))
+        self.tx_commit_latency_seconds = h(
+            "mempool", "tx_commit_latency_seconds",
+            "End-to-end seconds from a sampled tx's first lifecycle stamp "
+            "(rpc_received on the ingesting node) to its block commit.",
+            buckets=self.COMMIT_LATENCY_BUCKETS)
 
 
 class P2PMetrics:
@@ -380,6 +424,41 @@ class P2PMetrics:
         self.peer_send_bytes_total = reg.counter(
             "p2p", "peer_send_bytes_total",
             "Bytes sent per channel.", ["chID"])
+
+
+class RPCMetrics:
+    """The RPC front door (no reference analog — rpc/jsonrpc has no
+    metrics.go; an ingestion plane for millions of users starts with
+    knowing what each endpoint costs). Per-endpoint latency/outcome,
+    in-flight pressure, websocket-subscriber count, and request/response
+    size distributions, all served back over the same /metrics endpoint
+    the fleet scraper rolls up."""
+
+    LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+    SIZE_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+    def __init__(self, reg: Registry):
+        g, c, h = reg.gauge, reg.counter, reg.histogram
+        self.request_seconds = h(
+            "rpc", "request_seconds",
+            "RPC request latency per endpoint (outcome ok|error; unknown "
+            "methods are bucketed under endpoint=\"unknown\" so scans "
+            "cannot explode series cardinality).",
+            ["endpoint", "outcome"], buckets=self.LATENCY_BUCKETS)
+        self.requests_in_flight = g(
+            "rpc", "requests_in_flight",
+            "RPC requests currently being handled.")
+        self.websocket_subscribers = g(
+            "rpc", "websocket_subscribers",
+            "Open /websocket connections.")
+        self.request_size_bytes = h(
+            "rpc", "request_size_bytes",
+            "HTTP request body (POST) or path+query (GET) bytes.",
+            buckets=self.SIZE_BUCKETS)
+        self.response_size_bytes = h(
+            "rpc", "response_size_bytes",
+            "Serialized JSON response bytes.", buckets=self.SIZE_BUCKETS)
 
 
 class StateMetrics:
@@ -589,6 +668,7 @@ class NodeMetrics:
         self.registry = Registry(namespace)
         self.consensus = ConsensusMetrics(self.registry)
         self.mempool = MempoolMetrics(self.registry)
+        self.rpc = RPCMetrics(self.registry)
         self.p2p = P2PMetrics(self.registry)
         self.state = StateMetrics(self.registry)
         self.crypto = CryptoMetrics(self.registry)

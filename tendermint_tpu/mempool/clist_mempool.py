@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -56,6 +57,10 @@ class TxCache:
             self._map.popitem(last=False)
         return True
 
+    def has(self, key: bytes) -> bool:
+        """Membership by precomputed sha256 key (no recency bump)."""
+        return key in self._map
+
     def remove(self, tx: bytes) -> None:
         self._map.pop(hashlib.sha256(tx).digest(), None)
 
@@ -71,6 +76,7 @@ class CListMempool:
                  recheck: bool = True):
         self._proxy_app = proxy_app
         self.metrics = None  # MempoolMetrics, wired by the node
+        self.txlife = None  # libs/txlife.py TxLifecycle, wired by the node
         self._wal = None  # optional tx log (mempool/v0 WAL, mempool.go InitWAL)
         self._height = height
         self._max_txs = max_txs
@@ -113,33 +119,65 @@ class CListMempool:
         callback logic (resCbFirstTime) runs inline.
         """
         with self._mtx:
+            key = hashlib.sha256(tx).digest()
+            tl = self.txlife
             if len(tx) > self._max_tx_bytes:
+                self._count_failed("too-large")
+                self._mark_capacity_reject(tl, key)
                 raise MempoolError(
                     f"tx too large. Max size is {self._max_tx_bytes}, but got {len(tx)}")
             if len(self._txs) >= self._max_txs or \
                     self._txs_bytes + len(tx) > self._max_txs_bytes:
+                self._count_failed("full")
+                self._mark_capacity_reject(tl, key)
                 raise MempoolError(
                     f"mempool is full: number of txs {len(self._txs)} "
                     f"(max: {self._max_txs}), total bytes {self._txs_bytes}")
             if self.pre_check is not None:
-                self.pre_check(tx)
+                try:
+                    self.pre_check(tx)
+                except Exception:
+                    if tl is not None:
+                        tl.discard_phantom(key)
+                    raise
             if not self.cache.push(tx):
                 # record the new sender for an existing tx (clist_mempool.go:239)
-                key = hashlib.sha256(tx).digest()
                 existing = self._txs.get(key)
                 if existing is not None and sender:
                     existing.senders.add(sender)
+                # a duplicate is not a lifecycle event for the original
+                # (still-live) record — count it, don't mark it; but a
+                # retry of an already-SEALED tx just opened a fresh
+                # record at rpc_received that nothing will ever close
+                self._count_failed("cache-dup")
+                if tl is not None:
+                    tl.discard_phantom(key)
                 raise ErrTxInCache()
 
-            res = self._proxy_app.check_tx(abci.RequestCheckTx(tx=tx))
+            t0 = time.perf_counter()
+            try:
+                res = self._proxy_app.check_tx(abci.RequestCheckTx(tx=tx))
+                checktx_s = time.perf_counter() - t0
+                if self.post_check is not None:
+                    self.post_check(tx, res)
+            except Exception:
+                # a broken app connection (or raising post_check) under a
+                # broadcast storm must not leak one never-closed
+                # rpc_received record per attempt; the checktx_done mark
+                # below hasn't happened yet, so the record is still a
+                # pure phantom
+                if tl is not None:
+                    tl.discard_phantom(key)
+                raise
             if self.metrics is not None:
                 self.metrics.tx_size_bytes.observe(len(tx))
+                self.metrics.checktx_latency_seconds.observe(checktx_s)
                 if res.code != 0:
-                    self.metrics.failed_txs.inc()
-            if self.post_check is not None:
-                self.post_check(tx, res)
+                    self.metrics.failed_txs.labels("app-reject").inc()
+            if tl is not None:
+                tl.mark(key, "checktx_done",
+                        outcome="accepted" if res.is_ok() else "rejected")
             if res.is_ok():
-                key = hashlib.sha256(tx).digest()
                 mem_tx = MempoolTx(tx, self._height, res.gas_wanted,
                                    {sender} if sender else set(), key)
                 self._txs[key] = mem_tx
@@ -147,12 +185,39 @@ class CListMempool:
                 if self._wal is not None:
                     self._wal.write(tx)
                 if self.metrics is not None:
-                    self.metrics.size.set(len(self._txs))
+                    self.metrics.admitted_txs_total.inc()
+                    self._set_depth_gauges()
+                if tl is not None:
+                    tl.mark(key, "mempool_admitted")
                 self._notify_txs_available()
             else:
                 if not self._keep_invalid:
                     self.cache.remove(tx)
             return res
+
+    def _count_failed(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.failed_txs.labels(reason).inc()
+
+    def _mark_capacity_reject(self, tl, key: bytes) -> None:
+        """The capacity checks run BEFORE the cache check (reference
+        ordering), so a retry of an already-known tx can hit "full" too:
+        a cached key must not seal a bogus rejected record over the
+        ORIGINAL tx's lifecycle — drop the retry's rpc_received phantom
+        instead. Only genuinely-new txs record the rejection."""
+        if tl is None:
+            return
+        if self.cache.has(key):
+            tl.discard_phantom(key)
+        else:
+            tl.mark(key, "checktx_done", outcome="rejected")
+
+    def _set_depth_gauges(self) -> None:
+        """Caller holds the lock. EVERY mutation path lands here — check_tx
+        admission, update/recheck removals, and flush (which historically
+        left the size gauge stale at the pre-flush depth)."""
+        self.metrics.size.set(len(self._txs))
+        self.metrics.size_bytes.set(self._txs_bytes)
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         """(clist_mempool.go:521)"""
@@ -188,12 +253,19 @@ class CListMempool:
             self.pre_check = pre_check
         if post_check is not None:
             self.post_check = post_check
+        tl = self.txlife
         for tx, res in zip(txs, deliver_tx_responses):
+            key = hashlib.sha256(tx).digest()
             if res.is_ok():
                 self.cache.push(tx)  # committed: keep in cache to block resubmission
+                if tl is not None:
+                    # on the consensus path _finalize_commit already
+                    # stamped committed (before apply_block reached us),
+                    # making THIS mark the no-op; it is load-bearing on
+                    # the non-consensus apply paths (fast sync)
+                    tl.mark(key, "committed", height=height)
             elif not self._keep_invalid:
                 self.cache.remove(tx)
-            key = hashlib.sha256(tx).digest()
             mem_tx = self._txs.pop(key, None)
             if mem_tx is not None:
                 self._txs_bytes -= len(mem_tx.tx)
@@ -202,29 +274,45 @@ class CListMempool:
         if self._txs:
             self._notify_txs_available()
         if self.metrics is not None:
-            self.metrics.size.set(len(self._txs))
+            self._set_depth_gauges()
 
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on remaining txs post-block (clist_mempool.go:641)."""
+        tl = self.txlife
         for key in list(self._txs.keys()):
             mem_tx = self._txs[key]
             if self.metrics is not None:
                 self.metrics.recheck_times.inc()
+            t0 = time.perf_counter()
             res = self._proxy_app.check_tx(abci.RequestCheckTx(
                 tx=mem_tx.tx, type=abci.CHECK_TX_TYPE_RECHECK))
+            if self.metrics is not None:
+                self.metrics.recheck_latency_seconds.observe(
+                    time.perf_counter() - t0)
+            if tl is not None:
+                tl.mark(key, "rechecked",
+                        outcome="accepted" if res.is_ok() else "rejected")
             if self.post_check is not None:
                 self.post_check(mem_tx.tx, res)
             if not res.is_ok():
                 del self._txs[key]
                 self._txs_bytes -= len(mem_tx.tx)
+                if self.metrics is not None:
+                    self.metrics.evicted_txs_total.labels(
+                        "recheck-failed").inc()
                 if not self._keep_invalid:
                     self.cache.remove(mem_tx.tx)
 
     def flush(self) -> None:
         with self._mtx:
+            if self.metrics is not None and self._txs:
+                self.metrics.evicted_txs_total.labels("flush").inc(
+                    len(self._txs))
             self._txs.clear()
             self._txs_bytes = 0
             self.cache.reset()
+            if self.metrics is not None:
+                self._set_depth_gauges()
 
     # -- gossip support ----------------------------------------------------
 

@@ -93,6 +93,11 @@ class MempoolReactor(Reactor):
                     if peer.try_send(MEMPOOL_CHANNEL, encode_txs([mem_tx.tx])):
                         sent.add(mem_tx.key)
                         sent_any = True
+                        tl = getattr(self.mempool, "txlife", None)
+                        if tl is not None:
+                            # first stamp wins: per-peer routines racing
+                            # here still record the FIRST outbound gossip
+                            tl.mark(mem_tx.key, "first_gossip")
                 sent &= live  # forget evicted txs
                 await asyncio.sleep(0 if sent_any else self._gossip_sleep)
         except asyncio.CancelledError:

@@ -209,6 +209,16 @@ class Node:
 
         _tracer.drop_counter = self.metrics.trace_dropped_events_total
         self.mempool.metrics = self.metrics.mempool
+        # ingestion-plane lifecycle tracker (libs/txlife.py): hash-sampled
+        # per-tx stage stamps from the RPC front door through commit,
+        # feeding tendermint_mempool_tx_stage_seconds / _tx_commit_latency
+        # and the /tx_timeline route; reached via mempool.txlife by the
+        # RPC layer, the gossip reactor, and the consensus hooks
+        from .libs.txlife import TxLifecycle
+
+        self.txlife = TxLifecycle()
+        self.txlife.metrics = self.metrics.mempool
+        self.mempool.txlife = self.txlife
         self.block_exec.metrics = self.metrics.state
         from .p2p.conn.mconnection import set_p2p_metrics
 
@@ -357,6 +367,9 @@ class Node:
             from .rpc.server import RPCServer
 
             self.rpc_server = RPCServer(self)
+            # per-endpoint latency/outcome, in-flight, ws-subscriber, and
+            # size series onto the shared registry
+            self.rpc_server.metrics = self.metrics.rpc
 
         self.listen_addr = None
         self._started = False

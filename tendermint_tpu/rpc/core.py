@@ -293,6 +293,18 @@ class Environment:
                     "current": None, "heights": []}
         return tl.snapshot(int(limit))
 
+    async def tx_timeline(self, limit: int = 20) -> Dict[str, Any]:
+        """Per-tx lifecycle timeline tail (libs/txlife.py): the newest
+        ``limit`` sealed records — stage stamps from rpc_received through
+        committed/rejected — plus the tracker's sampling/bounds config.
+        The RPC view the open-loop load harness (tools/loadtime.py)
+        scrapes for in-node end-to-end latency truth."""
+        tl = getattr(self.node.mempool, "txlife", None)
+        if tl is None:
+            return {"enabled": False, "sample_rate": 0.0, "active": 0,
+                    "sealed_total": 0, "records": []}
+        return tl.snapshot(int(limit))
+
     async def check_tx(self, tx: str = "") -> Dict[str, Any]:
         """(rpc/core/mempool.go CheckTx route) run CheckTx against the app
         WITHOUT adding to the mempool."""
@@ -416,24 +428,47 @@ class Environment:
             "total_bytes": "0",
         }
 
+    def _mark_rpc_received(self, raw: bytes) -> bytes:
+        """Open the tx's lifecycle record (libs/txlife.py) at the RPC
+        front door; returns the tx hash every broadcast variant needs."""
+        tx_hash = hashlib.sha256(raw).digest()
+        tl = getattr(self.node.mempool, "txlife", None)
+        if tl is not None:
+            tl.mark(tx_hash, "rpc_received")
+        return tx_hash
+
     async def broadcast_tx_async(self, tx: str) -> Dict[str, Any]:
         raw = _decode_tx_param(tx)
-        asyncio.get_running_loop().call_soon(self.node.mempool.check_tx, raw)
+        tx_hash = self._mark_rpc_received(raw)
+        asyncio.get_running_loop().call_soon(self._check_tx_quiet, raw)
         return {"code": 0, "data": "", "log": "", "codespace": "",
-                "hash": hexu(hashlib.sha256(raw).digest())}
+                "hash": hexu(tx_hash)}
+
+    def _check_tx_quiet(self, raw: bytes) -> None:
+        """broadcast_tx_async's deferred CheckTx: admission errors (full
+        mempool, duplicate) have no response to ride on — swallow them
+        instead of dumping a traceback per tx into the loop's exception
+        handler under load."""
+        from ..mempool.clist_mempool import MempoolError
+
+        try:
+            self.node.mempool.check_tx(raw)
+        except MempoolError:
+            pass
 
     async def broadcast_tx_sync(self, tx: str) -> Dict[str, Any]:
         raw = _decode_tx_param(tx)
+        tx_hash = self._mark_rpc_received(raw)
         res = self.node.mempool.check_tx(raw)
         return {"code": res.code, "data": b64(res.data), "log": res.log,
                 "codespace": getattr(res, "codespace", ""),
-                "hash": hexu(hashlib.sha256(raw).digest())}
+                "hash": hexu(tx_hash)}
 
     async def broadcast_tx_commit(self, tx: str) -> Dict[str, Any]:
         """(rpc/core/mempool.go:64) CheckTx, then wait for the DeliverTx
         event with this tx's hash, bounded by timeout_broadcast_tx_commit."""
         raw = _decode_tx_param(tx)
-        tx_hash = hashlib.sha256(raw).digest()
+        tx_hash = self._mark_rpc_received(raw)
         bus = self.node.event_bus
         sub_id = f"rpc-btc-{tx_hash.hex()[:16]}-{time.monotonic_ns()}"
         query = (f"{tme.EVENT_TYPE_KEY}='{tme.EVENT_TX}' AND "
@@ -538,7 +573,8 @@ ROUTES = [
     "health", "status", "net_info", "genesis", "genesis_chunked",
     "blockchain", "block", "block_by_hash", "block_results", "commit",
     "check_tx", "validators", "consensus_state", "dump_consensus_state",
-    "consensus_stage_timeline", "consensus_params", "abci_info", "abci_query",
+    "consensus_stage_timeline", "tx_timeline", "consensus_params",
+    "abci_info", "abci_query",
     "unconfirmed_txs", "num_unconfirmed_txs", "broadcast_tx_async",
     "broadcast_tx_sync", "broadcast_tx_commit", "broadcast_evidence",
     "tx", "tx_search", "block_search",

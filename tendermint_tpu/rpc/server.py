@@ -10,6 +10,8 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import time
 from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
@@ -17,6 +19,16 @@ from aiohttp import WSMsgType, web
 from .core import Environment, ROUTES, UNSAFE_ROUTES, RPCError
 
 logger = logging.getLogger("tmtpu.rpc")
+
+
+def _slow_ms_knob() -> float:
+    """TMTPU_RPC_SLOW_MS: requests slower than this log one WARNING line
+    with endpoint + latency (0 disables — the default; the load harness
+    and incident debugging turn it on)."""
+    try:
+        return float(os.environ.get("TMTPU_RPC_SLOW_MS", "0") or 0)
+    except ValueError:
+        return 0.0
 
 
 def _rpc_response(id_, result=None, error: Optional[RPCError] = None) -> Dict:
@@ -31,6 +43,8 @@ class RPCServer:
     def __init__(self, node):
         self.node = node
         self.env = Environment(node)
+        self.metrics = None  # RPCMetrics, wired by the node
+        self.slow_ms = _slow_ms_knob()
         self._runner: Optional[web.AppRunner] = None
         self._subscriptions: Dict[str, list] = {}  # ws id -> [sub ids]
         self._routes = list(ROUTES)
@@ -59,10 +73,13 @@ class RPCServer:
     # -- JSON-RPC POST -------------------------------------------------------
 
     async def _handle_jsonrpc(self, request: web.Request) -> web.Response:
+        raw = await request.read()
+        if self.metrics is not None:
+            self.metrics.request_size_bytes.observe(len(raw))
         try:
-            body = await request.json()
-        except json.JSONDecodeError:
-            return web.json_response(
+            body = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return self._json_response(
                 _rpc_response(None, error=RPCError(-32700, "parse error")),
                 status=500)
         single = not isinstance(body, list)
@@ -70,11 +87,49 @@ class RPCServer:
         out = []
         for r in reqs:
             out.append(await self._dispatch(r))
-        return web.json_response(out[0] if single else out)
+        return self._json_response(out[0] if single else out)
+
+    def _json_response(self, payload, status: int = 200) -> web.Response:
+        """One serialization pass — the response-size histogram observes
+        the exact bytes that go on the wire."""
+        text = json.dumps(payload)
+        if self.metrics is not None:
+            self.metrics.response_size_bytes.observe(len(text))
+        return web.Response(text=text, status=status,
+                            content_type="application/json")
 
     async def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
-        id_ = req.get("id")
+        """The single funnel for POST, GET-URI, and websocket-carried
+        METHOD calls — instrumented once here so those entry paths share
+        the per-endpoint latency/outcome series and the in-flight gauge.
+        Websocket subscription management (subscribe/unsubscribe) is
+        handled inline in the ws loop and is visible through the
+        websocket_subscribers gauge instead."""
         method = req.get("method", "")
+        # unknown methods share one label: a port scan or fuzzing client
+        # must not mint unbounded series on the registry
+        endpoint = method if method in self._routes else "unknown"
+        m = self.metrics
+        t0 = time.perf_counter()
+        if m is not None:
+            m.requests_in_flight.inc()
+        try:
+            resp = await self._dispatch_inner(req, method)
+        finally:
+            if m is not None:
+                m.requests_in_flight.inc(-1)
+        elapsed = time.perf_counter() - t0
+        if m is not None:
+            outcome = "error" if "error" in resp else "ok"
+            m.request_seconds.labels(endpoint, outcome).observe(elapsed)
+        if self.slow_ms > 0 and elapsed * 1000.0 >= self.slow_ms:
+            logger.warning("slow rpc %s took %.1f ms (threshold %.0f ms)",
+                           endpoint, elapsed * 1000.0, self.slow_ms)
+        return resp
+
+    async def _dispatch_inner(self, req: Dict[str, Any],
+                              method: str) -> Dict[str, Any]:
+        id_ = req.get("id")
         params = req.get("params") or {}
         if method not in self._routes:
             return _rpc_response(id_, error=RPCError(-32601,
@@ -98,11 +153,14 @@ class RPCServer:
 
     def _make_uri_handler(self, name: str):
         async def handler(request: web.Request) -> web.Response:
+            if self.metrics is not None:
+                self.metrics.request_size_bytes.observe(
+                    len(request.path_qs))
             params = {}
             for k, v in request.query.items():
                 params[k] = _coerce(k, v)
             fake = {"id": -1, "method": name, "params": params}
-            return web.json_response(await self._dispatch(fake))
+            return self._json_response(await self._dispatch(fake))
         return handler
 
     # -- WebSocket subscriptions (ws_handler.go:32) --------------------------
@@ -112,6 +170,8 @@ class RPCServer:
         await ws.prepare(request)
         ws_id = f"ws-{id(ws)}"
         pumps: list = []
+        if self.metrics is not None:
+            self.metrics.websocket_subscribers.inc()
         try:
             async for msg in ws:
                 if msg.type != WSMsgType.TEXT:
@@ -135,6 +195,8 @@ class RPCServer:
                 else:
                     await ws.send_json(await self._dispatch(req))
         finally:
+            if self.metrics is not None:
+                self.metrics.websocket_subscribers.inc(-1)
             _quiet_unsubscribe(self.node.event_bus, ws_id)
             for p in pumps:
                 p.cancel()

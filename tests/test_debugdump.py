@@ -111,3 +111,31 @@ def test_dump_includes_device_snapshot(tmp_path):
     assert doc.get("jax_backend") == "cpu"
     assert len(doc.get("devices", [])) == 8
     phases.reset()
+
+
+def test_dump_includes_txlife_snapshot(tmp_path):
+    """txlife.json: a node carrying the tx lifecycle tracker bundles its
+    snapshot — terminal records and the in-flight depth at dump time."""
+    import hashlib
+    import json
+
+    from tendermint_tpu.libs.txlife import TxLifecycle
+
+    tl = TxLifecycle(sample_rate=1.0)
+    k = hashlib.sha256(b"dump-tx").digest()
+    tl.mark(k, "rpc_received")
+    tl.mark(k, "checktx_done", outcome="accepted")
+    tl.mark(k, "mempool_admitted")
+    tl.mark(k, "committed", height=4)
+
+    class _Mempool:
+        txlife = tl
+
+    class _Node:
+        mempool = _Mempool()
+
+    out = debugdump.write_dump(str(tmp_path / "dump"), node=_Node())
+    doc = json.load(open(os.path.join(out, "txlife.json")))
+    assert doc["sealed_total"] == 1
+    assert doc["records"][0]["terminal"] == "committed"
+    assert doc["records"][0]["height"] == 4

@@ -1,0 +1,238 @@
+"""RPC server telemetry (rpc/server.py + RPCMetrics): per-endpoint
+latency/outcome series, request/response size histograms, in-flight drain
+on handler exceptions, the unknown-method cardinality guard, websocket
+subscriber gauge, and the slow-request log knob — against a real aiohttp
+RPCServer over a stub node (no cryptography/tomllib needed, so the suite
+runs in slim containers too)."""
+
+import asyncio
+import json
+import logging
+from types import SimpleNamespace
+
+import pytest
+
+pytest.importorskip("aiohttp", reason="RPC server needs aiohttp")
+
+from tendermint_tpu.libs.metrics import RPCMetrics, Registry
+from tendermint_tpu.libs.txlife import TxLifecycle
+from tendermint_tpu.rpc.server import RPCServer
+from tendermint_tpu.types.event_bus import EventBus
+
+
+def _stub_node():
+    """The minimal node surface RPCServer + the handlers under test touch.
+    block_store is None on purpose: the `block` route then raises inside
+    its handler — the in-flight-drain-on-exception probe."""
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool import CListMempool
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    conns = AppConns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    mempool = CListMempool(conns.mempool)
+    mempool.txlife = TxLifecycle(sample_rate=1.0)
+    node = SimpleNamespace(
+        config=SimpleNamespace(rpc=SimpleNamespace(
+            laddr="tcp://127.0.0.1:0", max_body_bytes=1000000,
+            unsafe=False)),
+        mempool=mempool,
+        block_store=None,
+        event_bus=EventBus(),
+        _conns=conns,
+    )
+    return node
+
+
+async def _serve():
+    node = _stub_node()
+    server = RPCServer(node)
+    metrics = RPCMetrics(Registry())
+    server.metrics = metrics
+    await server.start("tcp://127.0.0.1:0")
+    return node, server, metrics
+
+
+async def _teardown(node, server):
+    await server.stop()
+    node._conns.stop()
+
+
+def test_per_endpoint_series_outcomes_and_sizes():
+    import aiohttp
+
+    async def run():
+        node, server, m = await _serve()
+        base = f"http://127.0.0.1:{server.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # GET URI route → ok outcome
+                async with s.get(base + "/health") as r:
+                    assert (await r.json())["result"] == {}
+                # POST JSON-RPC route → ok outcome + body sizes observed
+                payload = {"jsonrpc": "2.0", "id": 1,
+                           "method": "broadcast_tx_sync",
+                           "params": {"tx": "aGk="}}
+                async with s.post(base + "/", json=payload) as r:
+                    doc = await r.json()
+                assert doc["result"]["code"] == 0
+                # handler exception (block_store is None) → error outcome,
+                # NOT a transport-level failure
+                async with s.get(base + "/block?height=3") as r:
+                    doc = await r.json()
+                assert "error" in doc
+                # unknown method: one shared label, no cardinality mint
+                async with s.post(base + "/", json={
+                        "jsonrpc": "2.0", "id": 2,
+                        "method": "gimme_keys"}) as r:
+                    assert "error" in await r.json()
+        finally:
+            await _teardown(node, server)
+        assert m.request_seconds.count_value("health", "ok") == 1
+        assert m.request_seconds.count_value("broadcast_tx_sync", "ok") == 1
+        assert m.request_seconds.count_value("block", "error") == 1
+        assert m.request_seconds.count_value("unknown", "error") == 1
+        # in-flight drained through BOTH the ok and the exception paths
+        assert m.requests_in_flight.value() == 0
+        # sizes: both POST bodies and GET path+query observed, plus every
+        # serialized response
+        assert m.request_size_bytes.count_value() >= 4
+        assert m.response_size_bytes.count_value() >= 4
+        assert m.request_size_bytes.sum_value() > 0
+        assert m.response_size_bytes.sum_value() > 0
+        # the lifecycle front door: broadcast_tx_sync marked rpc_received
+        # and the tx went through checktx/admission
+        snap = node.mempool.txlife.snapshot()
+        assert snap["active"] == 1
+        text = "\n".join(m.request_seconds.render())
+        assert 'endpoint="broadcast_tx_sync"' in text
+
+    asyncio.run(run())
+
+
+def test_inflight_gauge_tracks_concurrent_requests():
+    """A slow handler holds the in-flight gauge up while it runs; the
+    gauge drains to zero afterwards even when the handler raises."""
+    import aiohttp
+
+    async def run():
+        node, server, m = await _serve()
+        base = f"http://127.0.0.1:{server.bound_port}"
+        release = asyncio.Event()
+        seen = {}
+
+        async def slow_health():
+            seen["inflight"] = m.requests_in_flight.value()
+            await release.wait()
+            raise RuntimeError("boom after the await")
+
+        server.env.health = slow_health
+        try:
+            async with aiohttp.ClientSession() as s:
+                task = asyncio.create_task(s.get(base + "/health"))
+                for _ in range(100):
+                    if seen:
+                        break
+                    await asyncio.sleep(0.01)
+                assert seen.get("inflight") == 1.0, seen
+                release.set()
+                async with await task as r:
+                    assert "error" in await r.json()
+        finally:
+            await _teardown(node, server)
+        assert m.requests_in_flight.value() == 0
+        assert m.request_seconds.count_value("health", "error") == 1
+
+    asyncio.run(run())
+
+
+def test_websocket_subscriber_gauge():
+    import aiohttp
+
+    async def run():
+        node, server, m = await _serve()
+        base = f"http://127.0.0.1:{server.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.ws_connect(base + "/websocket") as ws:
+                    await ws.send_json({"jsonrpc": "2.0", "id": 1,
+                                        "method": "subscribe",
+                                        "params": {"query":
+                                                   "tm.event='NewBlock'"}})
+                    msg = json.loads((await ws.receive()).data)
+                    assert msg["result"] == {}
+                    assert m.websocket_subscribers.value() == 1
+            # connection closed: gauge drains
+            for _ in range(100):
+                if m.websocket_subscribers.value() == 0:
+                    break
+                await asyncio.sleep(0.01)
+            assert m.websocket_subscribers.value() == 0
+        finally:
+            await _teardown(node, server)
+
+    asyncio.run(run())
+
+
+def test_tx_timeline_served_over_http():
+    import aiohttp
+
+    async def run():
+        node, server, m = await _serve()
+        base = f"http://127.0.0.1:{server.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                payload = {"jsonrpc": "2.0", "id": 1,
+                           "method": "broadcast_tx_sync",
+                           "params": {"tx": "dGw9MQ=="}}  # "tl=1"
+                async with s.post(base + "/", json=payload) as r:
+                    assert (await r.json())["result"]["code"] == 0
+                async with s.get(base + "/tx_timeline?limit=5") as r:
+                    doc = (await r.json())["result"]
+            assert doc["enabled"] is True and doc["active"] == 1
+            assert m.request_seconds.count_value("tx_timeline", "ok") == 1
+        finally:
+            await _teardown(node, server)
+
+    asyncio.run(run())
+
+
+def test_slow_request_log_knob(caplog):
+    import aiohttp
+
+    async def run():
+        node, server, m = await _serve()
+        server.slow_ms = 0.000001  # everything is "slow"
+        base = f"http://127.0.0.1:{server.bound_port}"
+        try:
+            with caplog.at_level(logging.WARNING, logger="tmtpu.rpc"):
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(base + "/health") as r:
+                        await r.json()
+        finally:
+            await _teardown(node, server)
+        assert any("slow rpc health" in rec.message
+                   for rec in caplog.records), caplog.records
+
+    asyncio.run(run())
+
+
+def test_disabled_metrics_server_still_serves():
+    """metrics=None (a server wired outside a Node) must not cost or
+    crash anything."""
+    import aiohttp
+
+    async def run():
+        node = _stub_node()
+        server = RPCServer(node)
+        assert server.metrics is None
+        await server.start("tcp://127.0.0.1:0")
+        base = f"http://127.0.0.1:{server.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(base + "/health") as r:
+                    assert (await r.json())["result"] == {}
+        finally:
+            await _teardown(node, server)
+
+    asyncio.run(run())

@@ -1,0 +1,497 @@
+"""Per-tx lifecycle tracker (libs/txlife.py): stage monotonicity, hash
+sampling determinism, ring/active bounds, terminal semantics, the real
+mempool/consensus integration, and the 4-node in-proc net putting
+tx_commit_latency observations on every node's registry."""
+
+import asyncio
+import hashlib
+import json
+
+from tendermint_tpu.libs.metrics import MempoolMetrics, NodeMetrics, Registry
+from tendermint_tpu.libs.trace import tracer
+from tendermint_tpu.libs.txlife import STAGES, TxLifecycle
+
+
+def _key(i) -> bytes:
+    return hashlib.sha256(b"tx-%d" % i).digest()
+
+
+def _drive_committed(tl, key, height=5):
+    tl.mark(key, "rpc_received")
+    tl.mark(key, "checktx_done", outcome="accepted")
+    tl.mark(key, "mempool_admitted")
+    tl.mark(key, "first_gossip")
+    tl.mark(key, "proposal_included", height=height)
+    tl.mark(key, "committed", height=height)
+
+
+def test_stage_monotonicity_and_seal():
+    tl = TxLifecycle(sample_rate=1.0)
+    m = MempoolMetrics(Registry())
+    tl.metrics = m
+    _drive_committed(tl, _key(1), height=7)
+    snap = tl.snapshot()
+    (rec,) = snap["records"]
+    assert rec["terminal"] == "committed" and rec["height"] == 7
+    # the acceptance shape: every stage from rpc_received through
+    # committed present, stamps monotonic in arrival order
+    assert [mk[0] for mk in rec["marks"]] == [
+        "rpc_received", "checktx_done", "mempool_admitted", "first_gossip",
+        "proposal_included", "committed"]
+    times = [t for _, t in rec["marks"]]
+    assert times == sorted(times)
+    assert all(d >= 0 for d in rec["durations"].values())
+    # durations and total_s are independently rounded to 1 us in the
+    # JSON view: allow half-ulp-per-stage accumulation
+    assert sum(rec["durations"].values()) <= rec["total_s"] + 1e-5
+    # both lifecycle histograms observed
+    for stage in ("rpc_received", "checktx_done", "mempool_admitted",
+                  "first_gossip", "proposal_included", "committed"):
+        assert m.tx_stage_seconds.count_value(stage) == 1, stage
+    assert m.tx_commit_latency_seconds.count_value() == 1
+    assert snap["active"] == 0 and snap["sealed_total"] == 1
+    json.dumps(snap)  # the RPC /tx_timeline + debugdump contract
+
+
+def test_duplicate_marks_first_wins_and_rechecks_count():
+    tl = TxLifecycle(sample_rate=1.0)
+    k = _key(2)
+    tl.mark(k, "rpc_received")
+    tl.mark(k, "checktx_done", outcome="accepted")
+    tl.mark(k, "checktx_done", outcome="accepted")  # dup: ignored
+    tl.mark(k, "mempool_admitted")
+    tl.mark(k, "rechecked", outcome="accepted")
+    tl.mark(k, "rechecked", outcome="accepted")  # rechecks repeat + count
+    tl.mark(k, "committed", height=3)
+    (rec,) = tl.snapshot()["records"]
+    assert [mk[0] for mk in rec["marks"]].count("checktx_done") == 1
+    assert rec["rechecks"] == 2
+
+
+def test_sampling_deterministic_by_tx_hash():
+    a = TxLifecycle(sample_rate=0.5)
+    b = TxLifecycle(sample_rate=0.5)
+    keys = [_key(i) for i in range(400)]
+    picks_a = [a.sampled(k) for k in keys]
+    picks_b = [b.sampled(k) for k in keys]
+    # two trackers (two nodes) sample the SAME txs — that is what lets
+    # trace_merge correlate one tx across a fleet
+    assert picks_a == picks_b
+    frac = sum(picks_a) / len(picks_a)
+    assert 0.35 < frac < 0.65, frac
+    # an unsampled tx never opens a record
+    unsampled = [k for k, p in zip(keys, picks_a) if not p][0]
+    a.mark(unsampled, "rpc_received")
+    assert a.snapshot()["active"] == 0
+    # rate 0 disables, rate 1 takes everything
+    assert not TxLifecycle(sample_rate=0.0).sampled(keys[0])
+    assert all(TxLifecycle(sample_rate=1.0).sampled(k) for k in keys)
+
+
+def test_ring_and_active_bounds():
+    tl = TxLifecycle(sample_rate=1.0, ring_capacity=8, active_capacity=16)
+    for i in range(50):
+        _drive_committed(tl, _key(1000 + i))
+    snap = tl.snapshot(10 ** 6)
+    assert len(snap["records"]) == 8
+    assert snap["sealed_total"] == 50
+    # active-map overflow: records evicted oldest-first, closed as "lost"
+    tl2 = TxLifecycle(sample_rate=1.0, ring_capacity=8, active_capacity=16)
+    for i in range(40):
+        tl2.mark(_key(2000 + i), "rpc_received")
+    snap2 = tl2.snapshot(10 ** 6)
+    assert snap2["active"] == 16
+    assert snap2["evicted_total"] == 24
+    assert all(r["terminal"] == "lost" for r in snap2["records"])
+
+
+def test_rejected_tx_terminal_stage():
+    tl = TxLifecycle(sample_rate=1.0)
+    m = MempoolMetrics(Registry())
+    tl.metrics = m
+    k = _key(3)
+    tl.mark(k, "rpc_received")
+    tl.mark(k, "checktx_done", outcome="rejected")
+    (rec,) = tl.snapshot()["records"]
+    assert rec["terminal"] == "rejected"
+    assert [mk[0] for mk in rec["marks"]] == ["rpc_received",
+                                              "checktx_done"]
+    # a rejected tx never observes commit latency
+    assert m.tx_commit_latency_seconds.count_value() == 0
+    assert m.tx_stage_seconds.count_value("checktx_done") == 1
+    # post-seal marks for the dead key are no-ops (no reopened record)
+    tl.mark(k, "committed", height=9)
+    assert tl.snapshot()["active"] == 0 and tl.snapshot()["sealed_total"] == 1
+
+
+def test_retry_of_sealed_tx_leaves_no_phantom_record():
+    """A client retrying an already-committed tx reopens a record at
+    rpc_received; the mempool's cache-dup path must discard it — a retry
+    storm must not evict genuine in-flight records. The live original of
+    a duplicate broadcast survives untouched."""
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool import CListMempool
+    from tendermint_tpu.mempool.clist_mempool import ErrTxInCache
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    conns = AppConns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    try:
+        mp = CListMempool(conns.mempool)
+        tl = TxLifecycle(sample_rate=1.0)
+        mp.txlife = tl
+        raw = b"retry=1"
+        key = hashlib.sha256(raw).digest()
+        # first broadcast: admitted, record live
+        tl.mark(key, "rpc_received")
+        mp.check_tx(raw)
+        # committed out-of-band: record sealed, tx stays cache-blocked
+        import tendermint_tpu.abci.types as abci
+
+        mp.update(2, [raw], [abci.ResponseCheckTx(code=0)])
+        assert tl.snapshot()["sealed_total"] == 1
+        # the retry: rpc_received reopens, cache-dup must discard it
+        tl.mark(key, "rpc_received")
+        try:
+            mp.check_tx(raw)
+            raise AssertionError("expected cache-dup rejection")
+        except ErrTxInCache:
+            pass
+        assert tl.snapshot()["active"] == 0, tl.snapshot()
+        # retry of the committed tx against a FULL mempool: the capacity
+        # check fires before the cache check — still no bogus sealed
+        # "rejected" record over the original's committed lifecycle
+        from tendermint_tpu.mempool.clist_mempool import MempoolError
+
+        mp._max_txs = 0
+        tl.mark(key, "rpc_received")
+        try:
+            mp.check_tx(raw)
+            raise AssertionError("expected full-mempool rejection")
+        except MempoolError:
+            pass
+        assert tl.snapshot()["active"] == 0
+        assert tl.snapshot()["sealed_total"] == 1  # only the commit record
+        # a genuinely NEW tx rejected at capacity DOES record the rejection
+        tl.mark(hashlib.sha256(b"fresh=1").digest(), "rpc_received")
+        try:
+            mp.check_tx(b"fresh=1")
+            raise AssertionError("expected full-mempool rejection")
+        except MempoolError:
+            pass
+        assert tl.snapshot()["sealed_total"] == 2
+        assert tl.tail(1)[0]["terminal"] == "rejected"
+        mp._max_txs = 5000
+
+        # a LIVE duplicate: the original record (past rpc_received) stays
+        raw2 = b"retry=2"
+        key2 = hashlib.sha256(raw2).digest()
+        tl.mark(key2, "rpc_received")
+        mp.check_tx(raw2)
+        tl.mark(key2, "rpc_received")  # duplicate broadcast, same tx live
+        try:
+            mp.check_tx(raw2)
+            raise AssertionError("expected cache-dup rejection")
+        except ErrTxInCache:
+            pass
+        assert tl.snapshot()["active"] == 1
+    finally:
+        conns.stop()
+
+
+def test_non_entry_stage_never_opens_a_record():
+    tl = TxLifecycle(sample_rate=1.0)
+    tl.mark(_key(4), "committed", height=2)
+    tl.mark(_key(4), "first_gossip")
+    assert tl.snapshot() == tl.snapshot()
+    assert tl.snapshot()["active"] == 0 and tl.snapshot()["sealed_total"] == 0
+
+
+def test_trace_spans_emitted_on_seal():
+    tl = TxLifecycle(sample_rate=1.0)
+    tracer.clear()
+    tracer.enable()
+    try:
+        _drive_committed(tl, _key(5), height=11)
+    finally:
+        tracer.disable()
+    spans = [e for e in tracer.events() if e["name"].startswith("tx_")]
+    tracer.clear()
+    assert [e["name"] for e in spans] == [
+        "tx_rpc_received", "tx_checktx_done", "tx_mempool_admitted",
+        "tx_first_gossip", "tx_proposal_included", "tx_committed"]
+    for e in spans:
+        assert e["ph"] == "X" and e["args"]["height"] == 11
+    # spans tile the lifecycle: each starts where the previous ended
+    for a, b in zip(spans, spans[1:]):
+        assert abs((a["ts"] + a["dur"]) - b["ts"]) < 1.0  # us
+
+
+def test_mempool_integration_admit_reject_flush():
+    """The real CListMempool against a kvstore app: lifecycle marks at
+    checktx/admission, reason-labeled rejections, and the flush() depth
+    gauge fix (historically left stale)."""
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool import CListMempool
+    from tendermint_tpu.mempool.clist_mempool import (
+        ErrTxInCache,
+        MempoolError,
+    )
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    conns = AppConns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    try:
+        mp = CListMempool(conns.mempool, max_txs=2, max_tx_bytes=64)
+        m = MempoolMetrics(Registry())
+        tl = TxLifecycle(sample_rate=1.0)
+        tl.metrics = m
+        mp.metrics = m
+        mp.txlife = tl
+
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        assert m.admitted_txs_total.value() == 2
+        assert m.size.value() == 2 and m.size_bytes.value() == 6
+        assert m.checktx_latency_seconds.count_value() == 2
+        # lifecycle: both admitted txs carry checktx_done+mempool_admitted
+        assert tl.snapshot()["active"] == 2
+
+        # full → reason="full", lifecycle sealed rejected
+        try:
+            mp.check_tx(b"c=3")
+            raise AssertionError("expected full-mempool rejection")
+        except MempoolError:
+            pass
+        assert m.failed_txs.value("full") == 1
+        # too-large → reason="too-large"
+        try:
+            mp.check_tx(b"d=" + b"x" * 100)
+            raise AssertionError("expected too-large rejection")
+        except MempoolError:
+            pass
+        assert m.failed_txs.value("too-large") == 1
+        # duplicate → reason="cache-dup", and the ORIGINAL record stays
+        # live (capacity raised first: the full check precedes the cache)
+        mp._max_txs = 3
+        try:
+            mp.check_tx(b"a=1")
+            raise AssertionError("expected cache-dup rejection")
+        except ErrTxInCache:
+            pass
+        assert m.failed_txs.value("cache-dup") == 1
+        assert tl.snapshot()["active"] == 2  # originals not sealed by dup
+        rejected = [r for r in tl.snapshot()["records"]
+                    if r["terminal"] == "rejected"]
+        assert len(rejected) == 2  # full + too-large
+
+        # the satellite fix: flush() updates BOTH depth gauges and counts
+        # the evictions — no more stale size gauge after unsafe_flush
+        mp.flush()
+        assert m.size.value() == 0 and m.size_bytes.value() == 0
+        assert m.evicted_txs_total.value("flush") == 2
+    finally:
+        conns.stop()
+
+
+def test_app_reject_reason_and_latency_series():
+    """An app-rejecting CheckTx lands reason="app-reject" and seals the
+    lifecycle record rejected."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool import CListMempool
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    class Rejecting(KVStoreApplication):
+        def check_tx(self, req):
+            return abci.ResponseCheckTx(code=1, log="no")
+
+    conns = AppConns(local_client_creator(Rejecting()))
+    conns.start()
+    try:
+        mp = CListMempool(conns.mempool)
+        m = MempoolMetrics(Registry())
+        tl = TxLifecycle(sample_rate=1.0)
+        mp.metrics = m
+        mp.txlife = tl
+        res = mp.check_tx(b"bad=1")
+        assert res.code == 1
+        assert m.failed_txs.value("app-reject") == 1
+        (rec,) = tl.snapshot()["records"]
+        assert rec["terminal"] == "rejected"
+    finally:
+        conns.stop()
+
+
+def test_app_exception_leaves_no_phantom_record():
+    """A broken ABCI connection (check_tx raising) under a broadcast
+    storm must not leak one never-closed rpc_received record per
+    attempt."""
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool import CListMempool
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    class Broken(KVStoreApplication):
+        def check_tx(self, req):
+            raise RuntimeError("app connection lost")
+
+    conns = AppConns(local_client_creator(Broken()))
+    conns.start()
+    try:
+        mp = CListMempool(conns.mempool)
+        tl = TxLifecycle(sample_rate=1.0)
+        mp.txlife = tl
+        for i in range(5):
+            raw = b"storm=%d" % i
+            tl.mark(hashlib.sha256(raw).digest(), "rpc_received")
+            try:
+                mp.check_tx(raw)
+                raise AssertionError("expected app exception")
+            except RuntimeError:
+                pass
+        assert tl.snapshot()["active"] == 0, tl.snapshot()
+    finally:
+        conns.stop()
+
+
+def test_single_validator_full_lifecycle_rpc_to_commit():
+    """The real state machine end-to-end: a tx entering through the
+    mempool (the RPC hook's next hop) is stamped through
+    proposal_included and committed with monotonic stamps — the
+    acceptance criterion's stage chain, minus only the rpc_received mark
+    the HTTP layer adds."""
+    from test_consensus_single import build_node, wait_for_height
+
+    async def run():
+        cs, mempool, app, event_bus, pv, _ = build_node()
+        m = MempoolMetrics(Registry())
+        tl = TxLifecycle(sample_rate=1.0)
+        tl.metrics = m
+        mempool.metrics = m
+        mempool.txlife = tl
+        await cs.start()
+        try:
+            raw = b"life=1"
+            tl.mark(hashlib.sha256(raw).digest(), "rpc_received")
+            mempool.check_tx(raw)
+            await wait_for_height(event_bus, cs, 3)
+        finally:
+            await cs.stop()
+        committed = [r for r in tl.snapshot(100)["records"]
+                     if r["terminal"] == "committed"]
+        assert committed, tl.snapshot()
+        (rec,) = committed
+        stages = [mk[0] for mk in rec["marks"]]
+        assert stages[:3] == ["rpc_received", "checktx_done",
+                              "mempool_admitted"]
+        assert "proposal_included" in stages and "committed" in stages
+        times = [t for _, t in rec["marks"]]
+        assert times == sorted(times)
+        assert rec["height"] is not None and rec["height"] >= 1
+        assert m.tx_commit_latency_seconds.count_value() == 1
+        assert m.tx_stage_seconds.count_value("proposal_included") == 1
+
+    asyncio.run(run())
+
+
+def test_four_node_net_commit_latency_on_every_registry():
+    """The acceptance shape, in-process: a real 4-validator net where one
+    node ingests a tx — EVERY node's registry must observe
+    tendermint_mempool_tx_commit_latency_seconds (followers stamp from
+    checktx_done at gossip receipt through proposal_included at
+    complete-proposal decode to committed)."""
+    from test_consensus_net import make_net, wait_all_height
+
+    from tendermint_tpu.p2p import InProcNetwork
+
+    async def run():
+        nodes = make_net(4)
+        metrics, trackers = [], []
+        for nd in nodes:
+            m = MempoolMetrics(Registry())
+            tl = TxLifecycle(sample_rate=1.0)
+            tl.metrics = m
+            nd.mempool.metrics = m
+            nd.mempool.txlife = tl
+            metrics.append(m)
+            trackers.append(tl)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2)
+            nodes[0].mempool.check_tx(b"fleet=1")
+            h0 = min(nd.cs.state.last_block_height for nd in nodes)
+            await wait_all_height(nodes, h0 + 2)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        gossiped = 0
+        for i, (m, tl) in enumerate(zip(metrics, trackers)):
+            assert m.tx_commit_latency_seconds.count_value() >= 1, i
+            committed = [r for r in tl.tail(100)
+                         if r["terminal"] == "committed"]
+            assert committed, (i, tl.snapshot())
+            rec = committed[0]
+            stages = [mk[0] for mk in rec["marks"]]
+            assert "checktx_done" in stages and "committed" in stages
+            assert "proposal_included" in stages, (i, stages)
+            times = [t for _, t in rec["marks"]]
+            assert times == sorted(times)
+            gossiped += sum(1 for r in tl.tail(100)
+                            for mk in r["marks"] if mk[0] == "first_gossip")
+            text = "\n".join(m.tx_commit_latency_seconds.render())
+            assert "tendermint_mempool_tx_commit_latency_seconds_count" \
+                in text
+        # somebody forwarded the tx (node0 at minimum)
+        assert gossiped > 0
+
+    asyncio.run(run())
+
+
+def test_tx_timeline_rpc_route():
+    """GET /tx_timeline through the Environment handler: the tracker's
+    snapshot verbatim, and a graceful empty shape with no tracker."""
+    from types import SimpleNamespace
+
+    from tendermint_tpu.rpc.core import Environment
+
+    tl = TxLifecycle(sample_rate=1.0)
+    _drive_committed(tl, _key(9), height=4)
+    node = SimpleNamespace(mempool=SimpleNamespace(txlife=tl))
+
+    async def run():
+        env = Environment(node)
+        doc = await env.tx_timeline(limit=5)
+        assert doc["sealed_total"] == 1
+        assert doc["records"][0]["terminal"] == "committed"
+        json.dumps(doc)
+        bare = Environment(SimpleNamespace(mempool=SimpleNamespace()))
+        doc2 = await bare.tx_timeline()
+        assert doc2["enabled"] is False and doc2["records"] == []
+
+    asyncio.run(run())
+
+
+def test_node_metrics_carries_lifecycle_series():
+    """NodeMetrics registers the grown mempool set + RPCMetrics without
+    name collisions, and renders the new series names."""
+    nm = NodeMetrics()
+    nm.mempool.tx_stage_seconds.labels("committed").observe(0.2)
+    nm.mempool.tx_commit_latency_seconds.observe(1.0)
+    nm.mempool.failed_txs.labels("full").inc()
+    nm.mempool.size_bytes.set(123)
+    nm.rpc.request_seconds.labels("status", "ok").observe(0.01)
+    nm.rpc.requests_in_flight.set(0)
+    text = nm.registry.render()
+    for needle in (
+            'tendermint_mempool_tx_stage_seconds_bucket',
+            "tendermint_mempool_tx_commit_latency_seconds_count",
+            'tendermint_mempool_failed_txs{reason="full"}',
+            "tendermint_mempool_size_bytes 123",
+            'tendermint_rpc_request_seconds_count{endpoint="status",'
+            'outcome="ok"}'):
+        assert needle in text, needle

@@ -223,6 +223,9 @@ def self_test() -> int:
                       "verify_commit_10k_multichip_sigs_per_sec":
                           (500000.0, "sigs/s"),
                       "localnet_4node_tx_commit_latency_p50": (1.1, "s"),
+                      "localnet_4node_ingest_txs_per_sec": (24.0, "txs/s"),
+                      "localnet_4node_ingest_commit_latency_p99_s":
+                          (2.0, "s"),
                       "verify_commit_10k_breakdown_pack_share":
                           (0.11, "ratio"),
                       "fast_sync_pipeline_breakdown_hash_store_share":
@@ -235,11 +238,72 @@ def self_test() -> int:
                     "verify_commit_10k_multichip_sigs_per_sec":
                         (480000.0, "sigs/s"),
                     "localnet_4node_tx_commit_latency_p50": (1.3, "s"),
+                    "localnet_4node_ingest_txs_per_sec": (22.0, "txs/s"),
+                    "localnet_4node_ingest_commit_latency_p99_s":
+                        (2.3, "s"),
                     "verify_commit_10k_breakdown_pack_share":
                         (0.13, "ratio"),
                     "fast_sync_pipeline_breakdown_hash_store_share":
                         (0.6, "ratio")})
         assert main([base, ok]) == 0
+        # the ingestion-plane rows gate like any throughput/latency pair:
+        # a collapsed ingest rate (open-loop load no longer keeping up)
+        # and a p99 blow-up each trip exit 1...
+        ing_bad = os.path.join(d, "ingest_bad.json")
+        _write(ing_bad, {"localnet_4node_ingest_txs_per_sec":
+                         (10.0, "txs/s"),
+                         "localnet_4node_ingest_commit_latency_p99_s":
+                         (6.0, "s")})
+        assert main(["--threshold", "verify_commit_10k_sigs_per_sec=9",
+                     "--threshold",
+                     "verify_commit_10k_multichip_sigs_per_sec=9",
+                     "--threshold",
+                     "localnet_4node_tx_commit_latency_p50=9",
+                     "--threshold",
+                     "verify_commit_10k_breakdown_pack_share=9",
+                     base, ing_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(base), load_bench(ing_bad), {})}
+        assert rows["localnet_4node_ingest_txs_per_sec"][
+            "status"] == "regressed"
+        assert rows["localnet_4node_ingest_commit_latency_p99_s"][
+            "status"] == "regressed"
+        # (ing_bad also dropped the flagship rows — flagged as missing)
+        assert rows["verify_commit_10k_sigs_per_sec"]["status"] == "missing"
+        # ...a VANISHED ingest metric fails on its own...
+        ing_gone = os.path.join(d, "ingest_gone.json")
+        _write(ing_gone, {
+            "verify_commit_10k_sigs_per_sec": (157000.0, "sigs/s"),
+            "verify_commit_10k_multichip_sigs_per_sec":
+                (500000.0, "sigs/s"),
+            "localnet_4node_tx_commit_latency_p50": (1.1, "s"),
+            "localnet_4node_ingest_txs_per_sec": (24.0, "txs/s"),
+            "verify_commit_10k_breakdown_pack_share": (0.11, "ratio"),
+        })
+        assert main([base, ing_gone]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(base), load_bench(ing_gone), {})}
+        assert rows["localnet_4node_ingest_commit_latency_p99_s"][
+            "status"] == "missing"
+        # ...and per-metric threshold overrides loosen both ingest gates
+        assert main(["--threshold", "localnet_4node_ingest_txs_per_sec=0.9",
+                     "--threshold",
+                     "localnet_4node_ingest_commit_latency_p99_s=9",
+                     "--threshold", "verify_commit_10k_sigs_per_sec=9",
+                     "--threshold",
+                     "verify_commit_10k_multichip_sigs_per_sec=9",
+                     "--threshold",
+                     "localnet_4node_tx_commit_latency_p50=9",
+                     "--threshold",
+                     "verify_commit_10k_breakdown_pack_share=9",
+                     base, ing_bad]) == 1  # missing flagships still fail
+        rows = {r["metric"]: r for r in compare(
+            load_bench(base), load_bench(ing_bad),
+            {"localnet_4node_ingest_txs_per_sec": 0.9,
+             "localnet_4node_ingest_commit_latency_p99_s": 9.0})}
+        assert rows["localnet_4node_ingest_txs_per_sec"]["status"] == "ok"
+        assert rows["localnet_4node_ingest_commit_latency_p99_s"][
+            "status"] == "ok"
         # flagship degraded 60%: gate trips — and the MULTICHIP flagship
         # is gated higher-better exactly like it (a silently-collapsed
         # device pool reads as a regression, not noise)
@@ -248,6 +312,9 @@ def self_test() -> int:
                      "verify_commit_10k_multichip_sigs_per_sec":
                          (150000.0, "sigs/s"),
                      "localnet_4node_tx_commit_latency_p50": (1.0, "s"),
+                     "localnet_4node_ingest_txs_per_sec": (24.0, "txs/s"),
+                     "localnet_4node_ingest_commit_latency_p99_s":
+                         (2.0, "s"),
                      "verify_commit_10k_breakdown_pack_share":
                          (0.11, "ratio")})
         assert main([base, bad]) == 1

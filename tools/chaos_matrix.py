@@ -18,6 +18,10 @@ deterministic seed, asserting the survival property that site promises:
   retry after disarm lands every record (no handled-but-not-durable)
 * net.drop            — 4-node in-proc net commits +3 heights under seeded
   10% loss with identical block hashes (the slow cell, ~30-60s)
+* ingest.mempool_full — open-loop tx load (loadtime schedule) into a
+  validator with an 8-slot mempool while another validator is partitioned
+  away: reason="full" rejections fire, the tx lifecycle ring stays
+  bounded, honest 3/4 keep committing hash-identical blocks
 
 Adversarial (content-corruption) cells — the Byzantine chaos suite:
 
@@ -69,6 +73,7 @@ SITES = {
     "wal.fsync": False,
     "db.write_batch": False,
     "net.drop": True,
+    "ingest.mempool_full": True,
     # adversarial cells (content corruption / Byzantine peers)
     "net.corrupt": True,
     "statesync.lying_chunk": False,
@@ -314,6 +319,84 @@ def cell_net_drop(seed: int) -> None:
         assert len(hashes) == 1, "divergent block hashes under loss"
 
     asyncio.run(run())
+
+
+def cell_ingest_mempool_full(seed: int) -> None:
+    """Ingestion-plane overload: open-loop tx load (tools/loadtime.py
+    schedule, fixed-rate grid) into ONE validator whose mempool is shrunk
+    to 8 slots, while a second validator is partitioned clean away. The
+    survival property: rejection counters fire with reason="full", the
+    tx lifecycle ring/active map stay bounded under the firehose, and the
+    3/4 honest majority keeps committing with identical hashes."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import loadtime as LT
+    from test_consensus_net import make_net, wait_all_height
+
+    from tendermint_tpu.libs.metrics import MempoolMetrics, Registry
+    from tendermint_tpu.libs.txlife import TxLifecycle
+    from tendermint_tpu.mempool.clist_mempool import MempoolError
+    from tendermint_tpu.p2p import InProcNetwork
+
+    ring_cap, active_cap = 32, 64
+    m = MempoolMetrics(Registry())
+    tl = TxLifecycle(sample_rate=1.0, ring_capacity=ring_cap,
+                     active_capacity=active_cap)
+    tl.metrics = m
+
+    async def run():
+        nodes = make_net(4)
+        victim = nodes[0].mempool
+        victim._max_txs = 8  # 8 slots vs a 400 tx/s firehose: always full
+        victim.metrics = m
+        victim.txlife = tl
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2, timeout=60)
+            # one node partitioned clean away: 3/4 voting power remains
+            net.partition({"node0", "node1", "node2"}, {"node3"})
+            honest = nodes[:3]
+            h0 = min(nd.cs.state.last_block_height for nd in honest)
+            loop = asyncio.get_running_loop()
+            sched = LT.plan_schedule(400.0, 240, t0=loop.time() + 0.05)
+            rejected = 0
+            for i, target in enumerate(sched):
+                now = loop.time()
+                if target > now:
+                    await asyncio.sleep(target - now)
+                tx = b"ingest-%d-%d=" % (seed, i) + b"x" * 64
+                try:
+                    victim.check_tx(tx)
+                except MempoolError:
+                    rejected += 1
+            assert rejected > 0, "mempool never filled under open-loop load"
+            # honest majority commits +2 heights DURING/after the overload
+            await wait_all_height(honest, h0 + 2, timeout=120)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        common = min(nd.cs.state.last_block_height for nd in nodes[:3]) - 1
+        hashes = {nd.block_store.load_block_meta(common).header.hash()
+                  for nd in nodes[:3]}
+        assert len(hashes) == 1, "divergent hashes among honest nodes"
+
+    asyncio.run(run())
+    # rejection counters fired with the right taxonomy...
+    assert m.failed_txs.value("full") > 0, "full-mempool counter never fired"
+    # ...and the lifecycle plane stayed bounded under the firehose
+    snap = tl.snapshot(10 ** 6)
+    assert len(snap["records"]) <= ring_cap, len(snap["records"])
+    assert snap["active"] <= active_cap, snap["active"]
+    assert snap["sealed_total"] > 0
+    # depth gauges were maintained on every mutation path: the final value
+    # is the real (small) post-run depth, never a stale high-water mark
+    assert m.size.value() <= 8, m.size.value()
 
 
 async def _live_net_under(site_spec: str, seed: int, extra_heights: int = 3,
@@ -625,6 +708,7 @@ CELLS = {
     "wal.fsync": cell_wal_fsync,
     "db.write_batch": cell_db_write_batch,
     "net.drop": cell_net_drop,
+    "ingest.mempool_full": cell_ingest_mempool_full,
     "net.corrupt": cell_net_corrupt,
     "statesync.lying_chunk": cell_statesync_lying_chunk,
     "statesync.lying_snapshot": cell_statesync_lying_snapshot,

@@ -197,17 +197,47 @@ class FleetScraper:
             out["cluster_blocks_per_min"] = round(blocks / elapsed * 60.0, 3)
         # gossip wakeups per directed peer link, from counter deltas summed
         # across nodes (each of the n nodes runs routines per peer)
-        wake_prefix = self._series_name("consensus_gossip_wakeups_total")
-        delta = 0.0
-        for n in nodes:
-            for s, v in last[n][1].items():
-                if s.split("{", 1)[0] == wake_prefix:
-                    # clamp at 0: a restarted node resets its counters
-                    # (Prometheus rate()-style counter-reset handling)
-                    delta += max(0.0, v - first[n][1].get(s, 0.0))
+        def counter_delta(prefix: str) -> float:
+            """Summed last-minus-first deltas across all nodes and label
+            sets of one counter family, clamped at 0 per series: a
+            restarted node resets its counters (Prometheus rate()-style
+            counter-reset handling)."""
+            total = 0.0
+            for n in nodes:
+                for s, v in last[n][1].items():
+                    if s.split("{", 1)[0] == prefix:
+                        total += max(0.0, v - first[n][1].get(s, 0.0))
+            return total
+
+        delta = counter_delta(
+            self._series_name("consensus_gossip_wakeups_total"))
         links = max(1, len(nodes) * (len(nodes) - 1))
         out["gossip_wakeups_delta"] = delta
         out["wakeups_per_peer_link"] = round(delta / links, 3)
+
+        # ingestion-plane rollups (mempool + RPC series): counter deltas
+        # summed across nodes over the scrape window — the cluster's tx
+        # admission/rejection rate and RPC traffic, the fleet view the
+        # ingest bench and the mempool_full chaos cell read
+        admitted = counter_delta(
+            self._series_name("mempool_admitted_txs_total"))
+        rejected = counter_delta(self._series_name("mempool_failed_txs"))
+        rpc_reqs = counter_delta(
+            self._series_name("rpc_request_seconds_count"))
+        out["txs_admitted_delta"] = admitted
+        out["txs_rejected_delta"] = rejected
+        out["rpc_requests_delta"] = rpc_reqs
+        # divide by the UNROUNDED window (the rounded elapsed_s is 0.0
+        # when only one sweep has landed — cluster_blocks_per_min floors
+        # the same way); rates only exist once the window is real
+        if nodes:
+            window = (max(last[n][0] for n in nodes)
+                      - min(first[n][0] for n in nodes))
+            if window > 0:
+                out["cluster_txs_admitted_per_sec"] = round(
+                    admitted / window, 3)
+                out["cluster_rpc_requests_per_sec"] = round(
+                    rpc_reqs / window, 3)
         return out
 
     def write(self, path: str) -> str:
@@ -252,6 +282,13 @@ def _serve_synthetic(n_nodes: int):
                     f"tendermint_consensus_committed_height {h}",
                     "tendermint_consensus_gossip_wakeups_total"
                     '{routine="data"} ' + str(20 * state["hits"]),
+                    "tendermint_mempool_admitted_txs_total "
+                    + str(5 * state["hits"]),
+                    'tendermint_mempool_failed_txs{reason="full"} '
+                    + str(2 * state["hits"]),
+                    "tendermint_rpc_request_seconds_count"
+                    '{endpoint="broadcast_tx_sync",outcome="ok"} '
+                    + str(8 * state["hits"]),
                     "tendermint_consensus_stage_seconds_sum"
                     '{stage="commit_finalized"} 0.5',
                     "tendermint_consensus_stage_seconds_count"
@@ -305,6 +342,14 @@ def self_test() -> int:
         assert roll["cluster_blocks_per_min"] > 0
         # wakeups: each node +20 per scrape -> delta 3*20 over 6 links
         assert abs(roll["wakeups_per_peer_link"] - 10.0) < 0.001, roll
+        # ingestion rollups: one extra scrape per node between first and
+        # last -> admitted +5, rejected +2, rpc +8, each summed over 3
+        # nodes; the per-second rates divide by the window
+        assert roll["txs_admitted_delta"] == 15.0, roll
+        assert roll["txs_rejected_delta"] == 6.0, roll
+        assert roll["rpc_requests_delta"] == 24.0, roll
+        assert roll["cluster_txs_admitted_per_sec"] > 0, roll
+        assert roll["cluster_rpc_requests_per_sec"] > 0, roll
         # threaded mode + out_path freshness
         import os
         import tempfile
