@@ -12,7 +12,8 @@ Configs (BASELINE.json):
   4  4-node localnet (kvstore), consensus end-to-end blocks/min
   5  fast-sync windowed replay @ 1000 validators
   ingest  open-loop broadcast_tx load on the 4-node localnet: sustained
-       committed txs/s + p99 broadcast->commit latency (tools/loadtime.py)
+       committed txs/s + p99 broadcast->commit latency + p99 admission
+       latency through the ingest fast path (tools/loadtime.py)
   multichip  devices x chunk scaling table (device_profile scale)
   10k  sustained VerifyCommit @ 10,240 validators (flagship, last) plus
        the multichip flagship through the multi-device dispatcher
@@ -827,9 +828,11 @@ def bench_ingest():
     cannot hide stalls); per-tx latency is recovered from committed blocks
     via the embedded planned-send timestamp, cross-checked against the
     nodes' own /tx_timeline lifecycle records; mempool/RPC ingestion
-    series ride along from node0's /metrics. Emits two gated rows:
-    localnet_4node_ingest_txs_per_sec (higher-better) and
-    localnet_4node_ingest_commit_latency_p99_s (lower-better)."""
+    series ride along from node0's /metrics. Emits three gated rows:
+    localnet_4node_ingest_txs_per_sec (higher-better),
+    localnet_4node_ingest_commit_latency_p99_s (lower-better), and
+    localnet_4node_ingest_checktx_p99_s (lower-better admission latency,
+    rpc_received→mempool_admitted measured in-node by txlife)."""
     import asyncio
     import shutil
     import signal
@@ -839,7 +842,11 @@ def bench_ingest():
 
     root = tempfile.mkdtemp(prefix="bench-ingest-")
     port0 = 28856  # clear of config 4's 28656 block when running "all"
-    rate, duration, size, clients = 25.0, 12.0, 96, 4
+    # 150 tx/s: 6x the PR 11 smoke rate — a load the pre-lane scalar
+    # admission path was never shown to sustain; the sharded-lane +
+    # async-admission fast path must hold it with p99 commit latency no
+    # worse (both rows gated in bench_compare, plus admission p99 below)
+    rate, duration, size, clients = 150.0, 12.0, 96, 8
     endpoint = f"http://127.0.0.1:{port0 + 1}"
     metrics_endpoint = f"http://127.0.0.1:{port0 + 8}/metrics"
 
@@ -852,7 +859,8 @@ def bench_ingest():
         # the crashed-config unit convention: both gated rows must read
         # as ERRORED in bench_compare, never as silent absence
         for metric in ("localnet_4node_ingest_txs_per_sec",
-                       "localnet_4node_ingest_commit_latency_p99_s"):
+                       "localnet_4node_ingest_commit_latency_p99_s",
+                       "localnet_4node_ingest_checktx_p99_s"):
             _emit(metric, 0.0, "error", 0.0, error=err)
 
     procs = []
@@ -928,6 +936,18 @@ def bench_ingest():
                   "complete_rpc_to_commit_records"),
               timeline_stage_counts=tlr.get("stage_counts"),
               timeline_sampled_sealed=tlr.get("sealed_total"))
+        # admission latency (rpc_received → mempool_admitted measured IN
+        # node0 by txlife): the async admission path's own cost, gated
+        # lower-better so intake-queue/batching regressions trip loudly
+        adm = tlr.get("admission_latency_s") or {}
+        if "p99" not in adm:
+            _emit("localnet_4node_ingest_checktx_p99_s", 0.0, "error", 0.0,
+                  error="no timeline records carried "
+                        "rpc_received+mempool_admitted marks")
+        else:
+            _emit("localnet_4node_ingest_checktx_p99_s", adm["p99"],
+                  "s", 0.0, admission_latency_s=adm,
+                  rejections=doc.get("rejections"))
     except Exception as e:
         emit_error(f"{type(e).__name__}: {e}")
     finally:

@@ -3,7 +3,8 @@
 Mirrors the reference's config system (config/config.go:66 Config struct:
 Base :158, RPC :305, P2P :517, Mempool :686, StateSync :792, FastSync :882,
 Consensus :917, Storage :1081, TxIndex :1117, Instrumentation :1148) and its
-TOML template writer (config/toml.go). Reading uses stdlib ``tomllib``;
+TOML template writer (config/toml.go). Reading uses stdlib ``tomllib`` when
+available and the 3.10-safe subset reader (libs/toml_compat.py) otherwise;
 writing emits a commented template so an operator can hand-edit the file the
 same way the reference's ``tendermint init`` output allows.
 
@@ -14,7 +15,6 @@ Defaults match the reference's DefaultConfig() values where they translate
 from __future__ import annotations
 
 import os
-import tomllib
 from dataclasses import asdict, dataclass, field, fields
 from typing import List, Optional
 
@@ -95,9 +95,13 @@ class P2PConfig:
 
 @dataclass
 class MempoolConfig:
-    """(config/config.go:686 MempoolConfig)"""
+    """(config/config.go:686 MempoolConfig — grown the ingestion fast
+    path's knobs: lane topology and admission control, mempool/ingest.py)"""
 
-    version: str = "v0"
+    # v2 = sharded per-sender lanes + async admission + batched signature
+    # pre-verification (mempool/ingest.py, the default); v0 = the CList
+    # port (mempool/clist_mempool.py)
+    version: str = "v2"
     recheck: bool = True
     broadcast: bool = True
     wal_dir: str = ""
@@ -109,6 +113,13 @@ class MempoolConfig:
     max_batch_bytes: int = 0
     ttl_duration: float = 0.0
     ttl_num_blocks: int = 0
+    # -- ingestion fast path (version v2 only) ------------------------------
+    lanes: int = 8                     # per-sender mempool lanes
+    ingest_queue_size: int = 2048      # intake bound; beyond it: queue-full
+    ingest_batch_max: int = 256        # pre-verification micro-batch cap
+    ingest_batch_deadline_s: float = 0.005  # flush deadline after first tx
+    ingest_per_sender_rate: float = 0.0  # tx/s per sender; 0 disables
+    ingest_fee_floor: int = 0          # min envelope fee; 0 admits unsigned
 
 
 @dataclass
@@ -226,10 +237,18 @@ class Config:
             raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
         if self.base.abci not in ("local", "socket", "grpc"):
             raise ValueError(f"unknown abci mode {self.base.abci!r}")
+        # "v1" is accepted as an alias for the lanes path: its priority
+        # ordering/eviction/TTL semantics live in the lane eviction policy
+        if self.mempool.version not in ("v0", "v1", "v2"):
+            raise ValueError(f"unknown mempool version {self.mempool.version!r}")
         if self.mempool.size <= 0:
             raise ValueError("mempool.size must be positive")
         if self.mempool.cache_size < 0:
             raise ValueError("mempool.cache_size must be non-negative")
+        if self.mempool.lanes <= 0:
+            raise ValueError("mempool.lanes must be positive")
+        if self.mempool.ingest_queue_size <= 0:
+            raise ValueError("mempool.ingest_queue_size must be positive")
         for name in ("timeout_propose", "timeout_prevote", "timeout_precommit",
                      "timeout_commit"):
             if getattr(self.consensus, name) < 0:
@@ -267,9 +286,11 @@ class Config:
 
     @classmethod
     def load(cls, root_dir: str, path: Optional[str] = None) -> "Config":
+        from .libs import toml_compat
+
         path = path or os.path.join(root_dir, CONFIG_DIR, "config.toml")
         with open(path, "rb") as f:
-            doc = tomllib.load(f)
+            doc = toml_compat.load(f)
         cfg = cls(root_dir=root_dir)
         base_fields = {f.name for f in fields(BaseConfig)}
         for k, v in doc.items():

@@ -47,7 +47,7 @@ def generate_one(rng: random.Random, idx: int) -> Tuple[str, dict]:
     for v in range(n_validators):
         node = {"mode": "validator"}
         if rng.random() < 0.5:
-            node["mempool_version"] = "v1"
+            node["mempool_version"] = "v2"
         if rng.random() < 0.25:
             node["privval"] = "tcp"
         # never perturb validator0: the net must keep making progress while
@@ -68,7 +68,7 @@ def generate_one(rng: random.Random, idx: int) -> Tuple[str, dict]:
     if rng.random() < 0.4:
         doc["node"]["full0"] = {
             "mode": "full",
-            "mempool_version": rng.choice(["v0", "v1"]),
+            "mempool_version": rng.choice(["v0", "v2"]),
         }
     if rng.random() < 0.6:
         joiner = {"mode": "full", "start_at": rng.randint(5, 8)}
@@ -113,9 +113,9 @@ def generate(seed: int, count: int = 4) -> List[Tuple[str, Manifest, str]]:
         toml_text = doc_to_toml(doc)
         # round-trip through the TOML parser so the written file is what the
         # runner will actually load
-        import tomllib
+        from ..libs import toml_compat
 
-        manifest = Manifest.from_doc(tomllib.loads(toml_text))
+        manifest = Manifest.from_doc(toml_compat.loads(toml_text))
         out.append((name, manifest, toml_text))
     return out
 

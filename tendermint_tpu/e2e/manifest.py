@@ -12,7 +12,7 @@ TOML shape:
 
     [node.validator0]
     mode = "validator"          # validator | full
-    mempool_version = "v1"      # v0 | v1
+    mempool_version = "v2"      # v0 | v2 (v1 = legacy alias for v2)
     fast_sync = true
     state_sync = false
     privval = "file"            # file | tcp (remote signer over SecretConn)
@@ -32,9 +32,10 @@ representable; the reference uses docker network disconnect
 
 from __future__ import annotations
 
-import tomllib
 from dataclasses import dataclass, field
 from typing import Dict, List
+
+from ..libs import toml_compat
 
 
 @dataclass
@@ -57,7 +58,7 @@ class NodeManifest:
     def validate(self) -> None:
         if self.mode not in ("validator", "full"):
             raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
-        if self.mempool_version not in ("v0", "v1"):
+        if self.mempool_version not in ("v0", "v1", "v2"):
             raise ValueError(
                 f"{self.name}: unknown mempool version {self.mempool_version!r}")
         if self.privval not in ("file", "tcp"):
@@ -96,7 +97,7 @@ class Manifest:
     @classmethod
     def load(cls, path: str) -> "Manifest":
         with open(path, "rb") as f:
-            doc = tomllib.load(f)
+            doc = toml_compat.load(f)
         return cls.from_doc(doc)
 
     @classmethod

@@ -380,14 +380,44 @@ class MempoolMetrics:
         self.failed_txs = c(
             "mempool", "failed_txs",
             "Txs rejected before admission, by reason "
-            "(cache-dup, app-reject, full, too-large).", ["reason"])
+            "(cache-dup, app-reject, full, too-large, invalid-sig, "
+            "malformed-stx).", ["reason"])
         self.admitted_txs_total = c(
             "mempool", "admitted_txs_total",
             "Txs that passed CheckTx and entered the mempool.")
         self.evicted_txs_total = c(
             "mempool", "evicted_txs_total",
             "Admitted txs removed without committing, by reason "
-            "(recheck-failed, flush).", ["reason"])
+            "(recheck-failed, flush, priority-evicted, ttl-expired).",
+            ["reason"])
+        # -- ingestion fast path (mempool/ingest.py) ---------------------
+        self.shed_txs_total = c(
+            "mempool", "shed_txs_total",
+            "Txs refused by admission control before any verification "
+            "or app work, by reason (queue-full, sender-rate, "
+            "fee-floor).", ["reason"])
+        self.intake_queue_depth = g(
+            "mempool", "intake_queue_depth",
+            "Ingest pipeline intake depth sampled at each micro-batch "
+            "flush (bounded by mempool.ingest_queue_size).")
+        self.preverified_txs_total = c(
+            "mempool", "preverified_txs_total",
+            "Signature pre-verification verdicts, by path/outcome "
+            "(accepted/rejected via the batched pipeline, scalar for "
+            "inline admissions).", ["outcome"])
+        self.preverify_cache_hits_total = c(
+            "mempool", "preverify_cache_hits_total",
+            "Signature checks skipped because a cached pre-verification "
+            "verdict stood, by consumer (batch, checktx, recheck — "
+            "recheck hits are what keep commits from re-verification "
+            "storms).", ["path"])
+        self.preverify_latency_seconds = h(
+            "mempool", "preverify_latency_seconds",
+            "Wall seconds one micro-batch spent in signature "
+            "pre-verification (host or device, routed by "
+            "crypto.BatchVerifier).",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
         self.recheck_times = c("mempool", "recheck_times",
                                "Times txs were rechecked.")
         self.checktx_latency_seconds = h(
@@ -402,8 +432,9 @@ class MempoolMetrics:
         self.tx_stage_seconds = h(
             "mempool", "tx_stage_seconds",
             "Seconds from the previous lifecycle stage stamp to this one "
-            "(rpc_received, checktx_done, mempool_admitted, first_gossip, "
-            "proposal_included, committed, rechecked).", ["stage"],
+            "(rpc_received, preverified, checktx_done, mempool_admitted, "
+            "first_gossip, proposal_included, committed, rechecked).",
+            ["stage"],
             buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1.0, 2.5, 5.0))
         self.tx_commit_latency_seconds = h(

@@ -9,6 +9,11 @@ committee-consensus evaluations are throughput/latency trade curves
 live, per sampled tx, as monotonic stage stamps:
 
     rpc_received        the tx arrived at a broadcast_tx_* RPC handler
+    preverified         the ingestion plane's batched (or scalar)
+                        signature pre-verification verdict landed
+                        (outcome accepted|rejected; rejected is
+                        terminal — an invalid signature never reaches
+                        the app)
     checktx_done        the app's CheckTx verdict landed (outcome
                         accepted|rejected; rejected is terminal)
     mempool_admitted    the tx entered the mempool
@@ -61,12 +66,14 @@ from .trace import tracer
 
 #: canonical stage order (README "Ingestion observability"); durations are
 #: deltas between consecutive STAMPED stages in this order
-STAGES = ("rpc_received", "checktx_done", "mempool_admitted", "first_gossip",
-          "proposal_included", "committed", "rechecked")
+STAGES = ("rpc_received", "preverified", "checktx_done", "mempool_admitted",
+          "first_gossip", "proposal_included", "committed", "rechecked")
 
 #: stages allowed to OPEN a record — everything else on an unknown key is
-#: a stale mark (e.g. a block commit for a tx sampled before a restart)
-ENTRY_STAGES = ("rpc_received", "checktx_done")
+#: a stale mark (e.g. a block commit for a tx sampled before a restart).
+#: Gossip-fed txs skip the RPC door AND the ingest pipeline, so both
+#: preverified and checktx_done can open a record.
+ENTRY_STAGES = ("rpc_received", "preverified", "checktx_done")
 
 DEFAULT_RING_CAPACITY = 512
 DEFAULT_ACTIVE_CAPACITY = 4096
@@ -174,7 +181,8 @@ class TxLifecycle:
                 rec["height"] = int(height)
             terminal = (stage == "committed"
                         or (outcome == "rejected"
-                            and stage in ("checktx_done", "rechecked")))
+                            and stage in ("preverified", "checktx_done",
+                                          "rechecked")))
             if not terminal:
                 return
             rec["terminal"] = ("committed" if stage == "committed"
@@ -188,18 +196,21 @@ class TxLifecycle:
         self._observe(rec, view)
 
     def discard_phantom(self, key: bytes) -> None:
-        """Drop an active record that never got past ``rpc_received``: a
-        client retrying an already-committed (cache-blocked) tx opens a
-        record at the RPC front door that no later stage will ever close
-        — under a retry storm those phantoms would evict genuine
-        in-flight records and flood the sealed ring with ``lost``
-        entries. A record with any post-RPC stamp is left alone (the
-        live original of a duplicate broadcast)."""
+        """Drop an active record that never got past the front door
+        (``rpc_received``/``preverified``): a client retrying an
+        already-committed (cache-blocked) tx opens a record — and with
+        the ingest pipeline in front, collects a preverified stamp —
+        that no later stage will ever close. Under a retry storm those
+        phantoms would evict genuine in-flight records and flood the
+        sealed ring with ``lost`` entries. A record with any admission
+        stamp (checktx_done onward) is left alone (the live original of
+        a duplicate broadcast)."""
         if not self.enabled:
             return
         with self._lock:
             rec = self._active.get(key)
-            if rec is not None and set(rec["_by_stage"]) <= {"rpc_received"}:
+            if rec is not None and \
+                    set(rec["_by_stage"]) <= {"rpc_received", "preverified"}:
                 self._active.pop(key, None)
 
     def tracking(self) -> bool:

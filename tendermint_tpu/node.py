@@ -116,9 +116,40 @@ class Node:
         self.initial_state = state
 
         # -- mempool (node.go:368) ------------------------------------------
-        self.mempool = CListMempool(self.proxy_app.mempool,
-                                    height=state.last_block_height)
+        mp_cfg = config.mempool
+        mp_common = dict(
+            height=state.last_block_height, max_txs=mp_cfg.size,
+            max_txs_bytes=mp_cfg.max_txs_bytes,
+            max_tx_bytes=mp_cfg.max_tx_bytes, cache_size=mp_cfg.cache_size,
+            keep_invalid_txs_in_cache=mp_cfg.keep_invalid_txs_in_cache,
+            recheck=mp_cfg.recheck)
+        self.ingest = None
+        if mp_cfg.version == "v0":
+            self.mempool = CListMempool(self.proxy_app.mempool, **mp_common)
+        else:
+            # the ingestion fast path (mempool/ingest.py): sharded
+            # per-sender lanes behind the same surface, plus the async
+            # admission pipeline broadcast_tx_* rides (rpc/core.py picks
+            # it up via node.ingest)
+            from .mempool.ingest import IngestPipeline, ShardedMempool
+
+            self.mempool = ShardedMempool(
+                self.proxy_app.mempool, lanes=mp_cfg.lanes,
+                ttl_num_blocks=mp_cfg.ttl_num_blocks,
+                ttl_duration=mp_cfg.ttl_duration, **mp_common)
+            self.ingest = IngestPipeline(
+                self.mempool, batch_max=mp_cfg.ingest_batch_max,
+                batch_deadline_s=mp_cfg.ingest_batch_deadline_s,
+                queue_limit=mp_cfg.ingest_queue_size,
+                per_sender_rate=mp_cfg.ingest_per_sender_rate,
+                fee_floor=mp_cfg.ingest_fee_floor)
         if config.mempool.wal_dir:
+            # NOTE: the WAL is append-only and never pruned on commit, so
+            # auto-replaying it at startup would re-admit already-committed
+            # txs (double execution for apps without replay protection) —
+            # mempool/ingest.replay_mempool_wal stays an EXPLICIT recovery
+            # tool, not a boot step (same stance as the reference, which
+            # keeps its mempool WAL write-only)
             from .mempool.clist_mempool import init_mempool_wal
 
             init_mempool_wal(self.mempool, config._rootify(config.mempool.wal_dir))
@@ -219,6 +250,10 @@ class Node:
         self.txlife = TxLifecycle()
         self.txlife.metrics = self.metrics.mempool
         self.mempool.txlife = self.txlife
+        if self.ingest is not None:
+            # admission-control shed counters + intake depth + batched
+            # pre-verification series onto the same mempool registry set
+            self.ingest.metrics = self.metrics.mempool
         self.block_exec.metrics = self.metrics.state
         from .p2p.conn.mconnection import set_p2p_metrics
 
@@ -561,6 +596,9 @@ class Node:
         await self.switch.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.ingest is not None:
+            # settle any in-flight micro-batch so no submit future strands
+            await self.ingest.stop()
         runner = getattr(self, "_metrics_runner", None)
         if runner is not None:
             await runner.cleanup()

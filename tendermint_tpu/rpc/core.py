@@ -440,7 +440,15 @@ class Environment:
     async def broadcast_tx_async(self, tx: str) -> Dict[str, Any]:
         raw = _decode_tx_param(tx)
         tx_hash = self._mark_rpc_received(raw)
-        asyncio.get_running_loop().call_soon(self._check_tx_quiet, raw)
+        ingest = getattr(self.node, "ingest", None)
+        if ingest is not None:
+            # async contract is fire-and-forget, but a shed is still an
+            # explicit (reason-labeled) rejection, not a silent drop
+            if not ingest.submit_nowait(raw):
+                return {"code": 1, "data": "", "log": "shed",
+                        "codespace": "ingest", "hash": hexu(tx_hash)}
+        else:
+            asyncio.get_running_loop().call_soon(self._check_tx_quiet, raw)
         return {"code": 0, "data": "", "log": "", "codespace": "",
                 "hash": hexu(tx_hash)}
 
@@ -456,10 +464,21 @@ class Environment:
         except MempoolError:
             pass
 
+    async def _admit_tx(self, raw: bytes):
+        """One admission seam for the sync/commit broadcast variants:
+        through the async ingest pipeline when the node carries one
+        (bounded intake, reason-labeled sheds, batched pre-verification
+        — overload comes back as an explicit non-zero code, never a
+        stall or an RPC 500), else the legacy inline CheckTx."""
+        ingest = getattr(self.node, "ingest", None)
+        if ingest is not None:
+            return await ingest.submit(raw)
+        return self.node.mempool.check_tx(raw)
+
     async def broadcast_tx_sync(self, tx: str) -> Dict[str, Any]:
         raw = _decode_tx_param(tx)
         tx_hash = self._mark_rpc_received(raw)
-        res = self.node.mempool.check_tx(raw)
+        res = await self._admit_tx(raw)
         return {"code": res.code, "data": b64(res.data), "log": res.log,
                 "codespace": getattr(res, "codespace", ""),
                 "hash": hexu(tx_hash)}
@@ -475,7 +494,7 @@ class Environment:
                  f"{tme.TX_HASH_KEY}='{tx_hash.hex().upper()}'")
         sub = bus.subscribe(sub_id, query)
         try:
-            check = self.node.mempool.check_tx(raw)
+            check = await self._admit_tx(raw)
             if check.code != 0:
                 return {
                     "check_tx": enc_tx_result(check),

@@ -28,7 +28,16 @@ logger = logging.getLogger("tmtpu.state")
 
 
 class Mempool:
-    """The surface BlockExecutor needs (reference mempool/mempool.go:30)."""
+    """The surface BlockExecutor needs (reference mempool/mempool.go:30).
+
+    ``reap_max_bytes_max_gas`` — the proposal-creation call site below —
+    must be DETERMINISTIC in the pool's contents: the CList port reaps
+    insertion order, the sharded-lane pool (mempool/ingest.py) a merged
+    (priority desc, arrival asc) order; either way two reaps over the
+    same residents yield the same block. ``update`` runs under
+    ``lock()``/``unlock()`` held across the whole commit (post-commit
+    recheck included), so admissions racing a commit serialize behind
+    it."""
 
     def lock(self) -> None: ...
     def unlock(self) -> None: ...

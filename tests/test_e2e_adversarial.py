@@ -16,9 +16,6 @@ import time
 import pytest
 
 pytest.importorskip(
-    "tomllib",
-    reason="config TOML loading needs Python 3.11+ stdlib tomllib")
-pytest.importorskip(
     "cryptography",
     reason="the multi-process net's TCP transport needs the optional "
            "'cryptography' package (absent in slim containers)")

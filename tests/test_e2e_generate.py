@@ -9,7 +9,6 @@ import random
 
 import pytest
 
-pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
 
 from tendermint_tpu.e2e import Manifest, Runner
 from tendermint_tpu.e2e.generate import doc_to_toml, generate, generate_one
@@ -41,8 +40,8 @@ def test_generate_covers_the_space():
     for seed in range(60):
         for _name, m, _toml in generate(seed=seed, count=3):
             for n in m.nodes:
-                if n.mempool_version == "v1":
-                    seen.add("mempool-v1")
+                if n.mempool_version == "v2":
+                    seen.add("mempool-v2")
                 if n.privval == "tcp":
                     seen.add("privval-tcp")
                 if n.state_sync:
@@ -56,7 +55,7 @@ def test_generate_covers_the_space():
                 if n.misbehaviors:
                     seen.add("misbehavior")
     missing = {
-        "mempool-v1", "privval-tcp", "state-sync", "late-join", "full-node",
+        "mempool-v2", "privval-tcp", "state-sync", "late-join", "full-node",
         "misbehavior", "perturb-kill", "perturb-restart", "perturb-pause",
         "perturb-disconnect",
     } - seen
@@ -67,9 +66,9 @@ def test_toml_round_trip_preserves_structure():
     rng = random.Random(3)
     for idx in range(10):
         _name, doc = generate_one(rng, idx)
-        import tomllib
+        from tendermint_tpu.libs import toml_compat
 
-        parsed = tomllib.loads(doc_to_toml(doc))
+        parsed = toml_compat.loads(doc_to_toml(doc))
         assert parsed["chain_id"] == doc["chain_id"]
         assert set(parsed["node"]) == set(doc["node"])
         for name, node in doc["node"].items():
@@ -84,6 +83,10 @@ def test_toml_round_trip_preserves_structure():
 @pytest.mark.nightly
 def test_generated_net_runs(tmp_path):
     """Nightly tier: one seeded net through the real runner pipeline."""
+    pytest.importorskip(
+        "cryptography",
+        reason="the subprocess net's TCP transport needs the optional "
+               "'cryptography' package (absent in slim containers)")
     _name, manifest, _toml = generate(seed=11, count=1)[0]
     r = Runner(manifest, str(tmp_path / "net"), base_port=29480)
     r.run()

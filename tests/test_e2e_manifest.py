@@ -12,7 +12,10 @@ import os
 
 import pytest
 
-pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
+pytest.importorskip(
+    "cryptography",
+    reason="the subprocess net's TCP transport needs the optional "
+           "'cryptography' package (absent in slim containers)")
 
 from tendermint_tpu.e2e import Manifest, Runner
 

@@ -192,8 +192,6 @@ def test_fail_point_named_kills_subprocess():
 
 
 def test_manifest_validates_fault_spec():
-    pytest.importorskip(
-        "tomllib", reason="manifest TOML loading needs Python 3.11+ tomllib")
     from tendermint_tpu.e2e.manifest import NodeManifest
 
     nm = NodeManifest(name="v0", faults="wal.fsync*1+3", faults_seed=9)

@@ -1,0 +1,497 @@
+"""Ingestion fast path (mempool/ingest.py): signed-tx envelope codec,
+tx-side sign-columns, sharded per-sender lanes, MempoolWAL replay through
+the lanes, async admission control with reason-labeled shedding, and the
+differential contract — batched pre-verification accept/reject is
+byte-identical to the scalar CheckTx path, with device failures degrading
+through the existing breaker to host fallback with zero lost txs."""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.libs.metrics import MempoolMetrics, Registry
+from tendermint_tpu.libs.txlife import STAGES, TxLifecycle
+from tendermint_tpu.mempool.clist_mempool import (
+    ErrTxInCache,
+    MempoolError,
+    init_mempool_wal,
+)
+from tendermint_tpu.mempool.ingest import (
+    MALFORMED,
+    SIGNED,
+    UNSIGNED,
+    IngestPipeline,
+    ShardedMempool,
+    make_signed_tx,
+    parse_signed_tx,
+    replay_mempool_wal,
+    tx_fee,
+    tx_sender,
+    verify_signed_tx_scalar,
+)
+
+KEYS = [crypto.Ed25519PrivKey.generate(bytes([0x40 + i]) * 32)
+        for i in range(4)]
+
+
+def _mk(**kw):
+    kw.setdefault("lanes", 4)
+    return ShardedMempool(LocalClient(KVStoreApplication()), **kw)
+
+
+def _flip_sig(tx: bytes) -> bytes:
+    return tx[:-1] + bytes([tx[-1] ^ 1])
+
+
+# --- signed-tx envelope ------------------------------------------------------
+
+class TestEnvelope:
+    def test_roundtrip(self):
+        tx = make_signed_tx(KEYS[0], b"k=v", nonce=9, fee=42)
+        status, stx = parse_signed_tx(tx)
+        assert status == SIGNED
+        assert stx.pubkey == KEYS[0].pub_key().bytes()
+        assert (stx.fee, stx.nonce, stx.payload) == (42, 9, b"k=v")
+        assert stx.sign_bytes == tx[:-64] and stx.sig == tx[-64:]
+        assert tx_fee(tx) == 42
+        assert tx_sender(tx) == KEYS[0].pub_key().bytes().hex()
+
+    def test_classification(self):
+        assert parse_signed_tx(b"a=1")[0] == UNSIGNED
+        assert parse_signed_tx(b"stx1-too-short")[0] == MALFORMED
+        assert parse_signed_tx(b"stx1" + b"\x00" * 111)[0] == MALFORMED
+        assert parse_signed_tx(b"stx1" + b"\x00" * 112)[0] == SIGNED
+
+    def test_scalar_verdicts(self):
+        good = make_signed_tx(KEYS[0], b"payload", 1, 5)
+        assert verify_signed_tx_scalar(good) == (True, "sig")
+        assert verify_signed_tx_scalar(_flip_sig(good)) == (False, "sig")
+        assert verify_signed_tx_scalar(b"plain") == (True, UNSIGNED)
+        assert verify_signed_tx_scalar(b"stx1oops") == (False, MALFORMED)
+
+    def test_unsigned_txs_hash_shard(self):
+        # every unsigned tx is its own "sender": per-sender controls can
+        # never collapse foreign-format traffic onto one bucket
+        assert tx_sender(b"a=1") != tx_sender(b"b=2")
+
+
+# --- tx-side sign columns ----------------------------------------------------
+
+class TestTxSignColumns:
+    def test_reconstructs_byte_identical(self):
+        from tendermint_tpu.crypto.signcols import sign_columns_from_rows
+
+        rows = [make_signed_tx(KEYS[i % 2], b"p" * 16, nonce=i,
+                               fee=3)[:-64] for i in range(8)]
+        cols = sign_columns_from_rows(rows)
+        assert cols is not None and len(cols) == 8
+        assert cols.rows() == rows
+        assert [cols[i] for i in range(8)] == rows
+        # nonce bytes vary; the shared magic/fee prefix does not
+        assert 0 < cols.cols.shape[0] < len(rows[0]) // 2
+
+    def test_guards(self):
+        from tendermint_tpu.crypto.signcols import sign_columns_from_rows
+
+        assert sign_columns_from_rows([b"one"]) is None  # too few
+        assert sign_columns_from_rows([b"aa", b"abc"]) is None  # ragged
+        import os
+
+        dense = [os.urandom(32) for _ in range(4)]  # no shared structure
+        assert sign_columns_from_rows(dense) is None
+
+
+# --- sharded lanes -----------------------------------------------------------
+
+class TestShardedLanes:
+    def test_lane_keying_is_deterministic_per_sender(self):
+        mp = _mk()
+        a1 = make_signed_tx(KEYS[0], b"a", 1, 0)
+        a2 = make_signed_tx(KEYS[0], b"b", 2, 0)
+        b1 = make_signed_tx(KEYS[1], b"c", 1, 0)
+        assert mp.lane_for(a1) == mp.lane_for(a2)  # same sender, same lane
+        for tx in (a1, a2, b1):
+            assert mp.check_tx(tx).is_ok()
+        assert sum(mp.lane_depths()) == 3
+        assert mp.lane_depths()[mp.lane_for(a1)] >= 2
+
+    def test_entries_after_global_admission_order(self):
+        mp = _mk()
+        txs = [make_signed_tx(KEYS[i % 4], b"x", i, 0) for i in range(8)]
+        for tx in txs:
+            mp.check_tx(tx)
+        entries, cursor = mp.entries_after(0)
+        assert cursor == 8
+        assert [e.tx for e in entries] == txs  # seq order across lanes
+        tail, _ = mp.entries_after(6)
+        assert [e.tx for e in tail] == txs[6:]
+
+    def test_dedup_is_global_across_lanes(self):
+        mp = _mk()
+        tx = make_signed_tx(KEYS[0], b"once", 1, 0)
+        assert mp.check_tx(tx, sender="peerA").is_ok()
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(tx, sender="peerB")
+        entries, _ = mp.entries_after(0)
+        assert entries[0].senders == {"peerA", "peerB"}
+
+    def test_depth_gauges_and_bytes(self):
+        mp = _mk()
+        m = MempoolMetrics(Registry())
+        mp.metrics = m
+        txs = [b"a=1", b"bb=2", make_signed_tx(KEYS[0], b"x", 1, 0)]
+        for tx in txs:
+            mp.check_tx(tx)
+        assert m.size.value() == 3
+        assert m.size_bytes.value() == sum(len(t) for t in txs)
+        assert mp.tx_bytes() == sum(len(t) for t in txs)
+        mp.flush()
+        assert m.size.value() == 0 and mp.size() == 0
+
+    def test_full_rejection_seals_lifecycle_record(self):
+        """A capacity rejection AFTER the app accepted must still seal
+        the tx's lifecycle record as rejected — never leave it to rot in
+        the active map as an eventual 'lost' eviction."""
+        mp = _mk(max_txs=1)
+        tl = TxLifecycle(sample_rate=1.0)
+        mp.txlife = tl
+        assert mp.check_tx(b"first=1").is_ok()
+        with pytest.raises(MempoolError, match="full"):
+            mp.check_tx(b"second=2")
+        snap = tl.snapshot(10)
+        assert snap["active"] == 1  # only the admitted tx's live record
+        sealed = {r["key"]: r for r in snap["records"]}
+        k2 = hashlib.sha256(b"second=2").digest().hex()
+        assert sealed[k2]["terminal"] == "rejected"
+
+    def test_recheck_reuses_preverification_verdicts(self):
+        """Lane-local recheck re-runs the app only: the cached signature
+        verdict stands, counted on preverify_cache_hits_total{recheck}."""
+        mp = _mk()
+        m = MempoolMetrics(Registry())
+        mp.metrics = m
+        signed = [make_signed_tx(KEYS[i], b"keep", i, 0) for i in range(3)]
+        for tx in signed:
+            assert mp.check_tx(tx).is_ok()
+        assert m.preverified_txs_total.value("scalar") == 3
+        mp.lock()
+        try:
+            mp.update(2, [signed[0]], [abci.ResponseCheckTx(code=0)])
+        finally:
+            mp.unlock()
+        assert mp.size() == 2
+        # both survivors recheck against the app, zero new sig verifies
+        assert m.preverify_cache_hits_total.value("recheck") == 2
+        assert m.preverified_txs_total.value("scalar") == 3
+
+
+# --- MempoolWAL replay through the lanes ------------------------------------
+
+class TestWALReplay:
+    def test_crash_replay_repopulates_lanes_no_dup_admits(self, tmp_path):
+        wal_dir = str(tmp_path / "mpwal")
+        mp = _mk()
+        init_mempool_wal(mp, wal_dir)
+        txs = [make_signed_tx(KEYS[i % 4], b"w", i, i) for i in range(6)]
+        txs.append(b"plain=tx")
+        for tx in txs:
+            assert mp.check_tx(tx).is_ok()
+        mp._wal.close()  # crash
+
+        fresh = _mk()  # the restarted node's empty lanes
+        replayed, skipped = replay_mempool_wal(fresh, wal_dir)
+        assert (replayed, skipped) == (7, 0)
+        assert fresh.size() == 7
+        assert sorted(t.tx for t, in zip(fresh.entries_after(0)[0])) == \
+            sorted(txs)
+        # lane placement re-derives deterministically
+        assert fresh.lane_depths() == mp.lane_depths()
+        # replay is idempotent: a second pass admits nothing new
+        replayed2, skipped2 = replay_mempool_wal(fresh, wal_dir)
+        assert (replayed2, skipped2) == (0, 7)
+        assert fresh.size() == 7
+        # and replay never re-appends to the log it reads
+        lines = open(f"{wal_dir}/wal", "rb").read().splitlines()
+        assert len(lines) == 7
+
+
+# --- async admission control -------------------------------------------------
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+class TestAdmissionControl:
+    def test_queue_full_shed(self):
+        async def main():
+            mp = _mk()
+            m = MempoolMetrics(Registry())
+            mp.metrics = m
+            pipe = IngestPipeline(mp, batch_deadline_s=0.2, queue_limit=3)
+            pipe.metrics = m
+            # 3 fill the intake; the 4th sheds with an explicit reason
+            futs = [asyncio.ensure_future(pipe.submit(b"q%d=1" % i))
+                    for i in range(3)]
+            await asyncio.sleep(0)
+            shed = await pipe.submit(b"q3=1")
+            assert shed.code == 1 and "queue-full" in shed.log
+            assert shed.codespace == "ingest"
+            assert m.shed_txs_total.value("queue-full") == 1
+            await pipe.flush_now()
+            assert all(r.is_ok() for r in await asyncio.gather(*futs))
+            assert mp.size() == 3
+            assert pipe.queue_depth() == 0
+
+        _run(main())
+
+    def test_fee_floor_shed(self):
+        async def main():
+            mp = _mk()
+            pipe = IngestPipeline(mp, batch_deadline_s=0.01, queue_limit=64,
+                                  fee_floor=10)
+            cheap = make_signed_tx(KEYS[0], b"c", 1, fee=3)
+            rich = make_signed_tx(KEYS[0], b"r", 2, fee=10)
+            r1 = await pipe.submit(cheap)
+            assert r1.code == 1 and "fee-floor" in r1.log
+            assert (await pipe.submit(rich)).is_ok()
+            # unsigned txs carry fee 0: a fee floor gates them out too
+            r3 = await pipe.submit(b"plain")
+            assert "fee-floor" in r3.log
+            assert pipe.stats["shed_fee-floor"] == 2
+
+        _run(main())
+
+    def test_per_sender_rate_shed(self):
+        async def main():
+            mp = _mk()
+            pipe = IngestPipeline(mp, batch_deadline_s=0.01, queue_limit=64,
+                                  per_sender_rate=2.0)
+            spam = [make_signed_tx(KEYS[0], b"s", i, 0) for i in range(5)]
+            results = [await pipe.submit(tx) for tx in spam]
+            sheds = [r for r in results if "sender-rate" in r.log]
+            assert len(sheds) == 3  # burst of 2, then throttled
+            # an unrelated sender is untouched
+            ok = await pipe.submit(make_signed_tx(KEYS[1], b"o", 1, 0))
+            assert ok.is_ok()
+
+        _run(main())
+
+    def test_shed_discards_txlife_phantom(self):
+        async def main():
+            mp = _mk()
+            tl = TxLifecycle(sample_rate=1.0)
+            mp.txlife = tl
+            pipe = IngestPipeline(mp, batch_deadline_s=0.2, queue_limit=1)
+            raw0, raw1 = b"keep=1", b"shed=1"
+            for raw in (raw0, raw1):
+                tl.mark(hashlib.sha256(raw).digest(), "rpc_received")
+            fut = asyncio.ensure_future(pipe.submit(raw0))
+            await asyncio.sleep(0)
+            shed = await pipe.submit(raw1)
+            assert shed.code == 1
+            await pipe.flush_now()
+            assert (await fut).is_ok()
+            snap = tl.snapshot(10)
+            # the shed tx's front-door phantom is gone, not "lost"
+            assert snap["active"] == 1  # only the admitted tx's record
+            assert all(r["terminal"] != "lost" for r in snap["records"])
+
+        _run(main())
+
+
+# --- batched pre-verification: the differential contract ---------------------
+
+def _mixed_batch():
+    """valid / bad-sig / malformed / unsigned / duplicate — every
+    classification the pre-verifier can meet, in one arrival order."""
+    good = [make_signed_tx(KEYS[i % 4], b"p%d" % i, i, i % 3)
+            for i in range(6)]
+    bad = [_flip_sig(make_signed_tx(KEYS[0], b"evil%d" % i, 100 + i, 0))
+           for i in range(2)]
+    malformed = [b"stx1short", b"stx1" + b"\x01" * 60]
+    unsigned = [b"u%d=v" % i for i in range(3)]
+    return good + bad + malformed + unsigned + [good[0]]  # trailing dup
+
+
+class TestDifferential:
+    def test_batched_accept_reject_matches_scalar(self):
+        batch = _mixed_batch()
+
+        # SCALAR reference: the inline ShardedMempool path
+        scalar = _mk()
+        scalar_out = []
+        for tx in batch:
+            try:
+                scalar_out.append(scalar.check_tx(tx).is_ok())
+            except (ErrTxInCache, MempoolError):
+                scalar_out.append(False)
+
+        # BATCHED: the same arrivals through one pipeline micro-batch
+        async def main():
+            mp = _mk()
+            pipe = IngestPipeline(mp, batch_max=len(batch) + 1,
+                                  batch_deadline_s=5.0, queue_limit=256)
+            futs = [asyncio.ensure_future(pipe.submit(tx)) for tx in batch]
+            await asyncio.sleep(0)
+            await pipe.flush_now()
+            return [(await f).is_ok() for f in futs], mp
+
+        batched_out, mp = _run(main())
+        assert batched_out == scalar_out
+        assert pipe_contents(mp) == pipe_contents(scalar)
+        assert _run_stats_sigs(batch) > 0
+
+    def test_breaker_degrades_device_to_host_zero_lost_txs(self):
+        """A sick device (armed device.batch_verify chaos site) degrades
+        through the existing breaker to host fallback: verdicts stay
+        byte-identical, every accepted tx is admitted, the breaker saw
+        the failures."""
+        from tendermint_tpu.crypto.batch import BatchVerifier, stats
+        from tendermint_tpu.crypto.breaker import device_breaker
+        from tendermint_tpu.libs.faults import faults
+
+        batch = _mixed_batch()
+        scalar = _mk()
+        scalar_out = []
+        for tx in batch:
+            try:
+                scalar_out.append(scalar.check_tx(tx).is_ok())
+            except (ErrTxInCache, MempoolError):
+                scalar_out.append(False)
+
+        faults.configure("device.batch_verify@1.0", seed=7)
+        errors_before = stats["device_errors"]
+
+        async def main():
+            mp = _mk()
+            pipe = IngestPipeline(
+                mp, batch_max=len(batch) + 1, batch_deadline_s=5.0,
+                queue_limit=256,
+                verifier_factory=lambda: BatchVerifier(backend="jax",
+                                                       plane="ingest"))
+            futs = [asyncio.ensure_future(pipe.submit(tx)) for tx in batch]
+            await asyncio.sleep(0)
+            await pipe.flush_now()
+            return [(await f).is_ok() for f in futs], mp
+
+        try:
+            batched_out, mp = _run(main())
+        finally:
+            faults.reset()
+        assert batched_out == scalar_out  # byte-identical under failure
+        assert pipe_contents(mp) == pipe_contents(scalar)  # zero lost txs
+        assert stats["device_errors"] > errors_before
+        assert device_breaker.stats["failures"] > 0
+
+    def test_verdict_cache_spares_resubmission(self):
+        async def main():
+            mp = _mk()
+            m = MempoolMetrics(Registry())
+            mp.metrics = m
+            pipe = IngestPipeline(mp, batch_deadline_s=0.002, queue_limit=64)
+            pipe.metrics = m
+            tx = make_signed_tx(KEYS[2], b"cached", 1, 0)
+            assert (await pipe.submit(tx)).is_ok()
+            dup = await pipe.submit(tx)  # same tx again: cache verdict
+            assert dup.code == 1 and "cache" in dup.log
+            assert m.preverify_cache_hits_total.value("batch") == 1
+
+        _run(main())
+
+    def test_txlife_preverified_stage(self):
+        assert "preverified" in STAGES
+        assert STAGES.index("preverified") == STAGES.index("rpc_received") + 1
+
+        async def main():
+            mp = _mk()
+            tl = TxLifecycle(sample_rate=1.0)
+            mp.txlife = tl
+            pipe = IngestPipeline(mp, batch_deadline_s=0.002, queue_limit=64)
+            good = make_signed_tx(KEYS[1], b"ok", 1, 0)
+            bad = _flip_sig(make_signed_tx(KEYS[1], b"no", 2, 0))
+            for raw in (good, bad):
+                tl.mark(hashlib.sha256(raw).digest(), "rpc_received")
+            r_good = await pipe.submit(good)
+            r_bad = await pipe.submit(bad)
+            assert r_good.is_ok() and r_bad.code == 1
+            recs = {r["key"]: r for r in tl.snapshot(10)["records"]}
+            bad_rec = recs[hashlib.sha256(bad).digest().hex()]
+            assert bad_rec["terminal"] == "rejected"
+            assert [m[0] for m in bad_rec["marks"]] == \
+                ["rpc_received", "preverified"]
+            # the admitted tx's live record carries the full front chain
+            active_stages = [m[0] for m in tl._active[
+                hashlib.sha256(good).digest()]["marks"]]
+            assert active_stages == ["rpc_received", "preverified",
+                                     "checktx_done", "mempool_admitted"]
+
+        _run(main())
+
+
+def test_signed_txs_through_pipeline_commit_on_a_live_net():
+    """End to end: signed envelope txs → async pipeline (one micro-batch,
+    one BatchVerifier call) → sharded lanes → gossip → every node commits
+    them in hash-identical blocks. The non-RPC nodes run the plain CList:
+    the two mempools interoperate on the same wire."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests")
+    from test_consensus_net import make_net, wait_all_height
+
+    from tendermint_tpu.p2p import InProcNetwork
+
+    txs = [make_signed_tx(KEYS[i % 3], b"k%d=v" % i, i, fee=i % 5)
+           for i in range(12)]
+
+    async def run():
+        nodes = make_net(4)
+        sm = ShardedMempool(nodes[0].conns.mempool, lanes=4)
+        nodes[0].mempool = sm
+        nodes[0].block_exec.mempool = sm
+        nodes[0].mp_reactor.mempool = sm
+        sm.tx_available_callbacks.append(nodes[0].cs.notify_txs_available)
+        pipe = IngestPipeline(sm, batch_deadline_s=0.01, queue_limit=128)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2, timeout=60)
+            results = await asyncio.gather(*(pipe.submit(tx) for tx in txs))
+            assert all(r.is_ok() for r in results)
+            assert pipe.stats["batched_sigs"] == 12
+            h0 = nodes[0].cs.state.last_block_height
+            await wait_all_height(nodes, h0 + 3, timeout=60)
+        finally:
+            await pipe.stop()
+            for nd in nodes:
+                await nd.stop()
+        committed = set()
+        store = nodes[1].block_store  # a NON-ingesting node: gossip proof
+        for h in range(1, store.height() + 1):
+            for tx in store.load_block(h).data.txs:
+                committed.add(bytes(tx))
+        assert not [t for t in txs if t not in committed], \
+            "signed txs never committed"
+        hashes = {nd.block_store.load_block_meta(2).header.hash()
+                  for nd in nodes}
+        assert len(hashes) == 1
+
+    _run(run())
+
+
+def pipe_contents(mp) -> set:
+    entries, _ = mp.entries_after(0)
+    return {e.tx for e in entries}
+
+
+def _run_stats_sigs(batch) -> int:
+    # sanity: the mixed batch really contains signature work
+    return sum(1 for tx in batch if parse_signed_tx(tx)[0] == SIGNED)

@@ -193,7 +193,6 @@ def _resign(blocks, keys):
 def test_light_client_against_live_node(tmp_path):
     """HTTPProvider + LightClient against a real node over RPC: the decode
     path (ns-exact times, hashes) must reproduce header hashes bit-exactly."""
-    pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
     pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from tests.test_node_rpc import _mk_node
     from tendermint_tpu.light.provider import HTTPProvider
@@ -256,7 +255,6 @@ def test_verify_chain_batched_parity():
 def test_light_proxy_verifies_primary(tmp_path):
     """Light proxy (reference light/proxy): commit/block/validators answers
     are verified against light-client state; a lying primary is rejected."""
-    pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
     pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from tests.test_node_rpc import _mk_node
     from tendermint_tpu.light.provider import HTTPProvider
@@ -330,7 +328,6 @@ def test_light_proxy_verifies_abci_query(tmp_path):
     missing proof are rejected."""
     import base64 as b64mod
 
-    pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
     pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from tests.test_node_rpc import _mk_node
     from tendermint_tpu.light.provider import HTTPProvider
@@ -388,7 +385,6 @@ def test_light_proxy_merkle_query_end_to_end(tmp_path):
     a lying primary forging the value is rejected."""
     import base64 as b64mod
 
-    pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
     pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
     from tests.test_node_rpc import _mk_node
     from tendermint_tpu.light.provider import HTTPProvider

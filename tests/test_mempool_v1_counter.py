@@ -1,16 +1,21 @@
-"""v1 priority mempool semantics (reference mempool/v1/mempool.go) and the
-counter example app (reference abci/example/counter).
-"""
+"""The v1 priority mempool's ordering/eviction/TTL semantics, now folded
+into the sharded-lane eviction policy (mempool/ingest.py ShardedMempool —
+the standalone priority_mempool module is gone), plus the counter example
+app (reference abci/example/counter)."""
+
+import pytest
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.application import Application
 from tendermint_tpu.abci.example.counter import CounterApplication
-from tendermint_tpu.mempool.priority_mempool import PriorityMempool
+from tendermint_tpu.mempool.clist_mempool import ErrTxInCache, MempoolError
+from tendermint_tpu.mempool.ingest import ShardedMempool
 from tendermint_tpu.proxy import AppConns, local_client_creator
 
 
 class PrioApp(Application):
-    """Assigns priority = first byte of the tx."""
+    """Assigns priority = first byte of the tx (the app-priority seam
+    unsigned txs fall back to; signed txs carry their envelope fee)."""
 
     def check_tx(self, req):
         if req.tx == b"":
@@ -18,19 +23,20 @@ class PrioApp(Application):
         return abci.ResponseCheckTx(code=0, priority=req.tx[0], gas_wanted=1)
 
 
-def _mk(maxtxs=3):
+def _mk(maxtxs=3, **kw):
     conns = AppConns(local_client_creator(PrioApp()))
     conns.start()
-    return PriorityMempool(conns.mempool, max_txs=maxtxs)
+    return ShardedMempool(conns.mempool, max_txs=maxtxs, lanes=4, **kw)
 
 
 def test_priority_ordering_and_reap():
     mp = _mk(maxtxs=10)
     for tx in (b"\x05low", b"\x50mid", b"\xa0high"):
         assert mp.check_tx(tx).code == 0
+    # merged reap across lanes: priority desc, arrival asc
     assert mp.reap_max_txs(10) == [b"\xa0high", b"\x50mid", b"\x05low"]
-    # byte/gas caps respected
-    assert mp.reap_max_bytes_max_gas(5, -1) == [b"\xa0high"]
+    # byte/gas caps respected (skip-what-doesn't-fit, v1 semantics)
+    assert mp.reap_max_bytes_max_gas(7, -1) == [b"\xa0high"]
     assert len(mp.reap_max_bytes_max_gas(-1, 2)) == 2
 
 
@@ -38,25 +44,59 @@ def test_eviction_of_lower_priority_when_full():
     mp = _mk(maxtxs=3)
     for tx in (b"\x10a", b"\x20b", b"\x30c"):
         assert mp.check_tx(tx).code == 0
-    # lower-priority incoming is rejected outright
-    assert mp.check_tx(b"\x01z").code != 0
+    # lower-priority incoming is rejected outright (explicit full error)
+    with pytest.raises(MempoolError, match="full"):
+        mp.check_tx(b"\x01z")
     assert mp.size() == 3
     # higher-priority incoming evicts the lowest resident
     assert mp.check_tx(b"\x99hi").code == 0
     assert mp.size() == 3
     txs = mp.reap_max_txs(10)
     assert b"\x99hi" in txs and b"\x10a" not in txs
+    # the evicted tx left the dedup cache too (not a cache-dup rejection):
+    # resubmitting it fails on capacity again, not ErrTxInCache
+    with pytest.raises(MempoolError, match="full"):
+        mp.check_tx(b"\x10a")
+
+
+def test_equal_priority_is_fifo():
+    """Ties break by arrival order — with flat priorities the merged reap
+    degenerates to the v0 FIFO, whatever lane each tx landed in."""
+    mp = _mk(maxtxs=10)
+    txs = [b"\x20" + bytes([i]) * 3 for i in range(6)]
+    for tx in txs:
+        assert mp.check_tx(tx).code == 0
+    assert mp.reap_max_txs(-1) == txs
 
 
 def test_update_removes_committed_and_rechecks():
     mp = _mk(maxtxs=10)
     mp.check_tx(b"\x10a")
     mp.check_tx(b"\x20b")
-    mp.update(2, [b"\x10a"])
+    mp.lock()
+    try:
+        mp.update(2, [b"\x10a"], [abci.ResponseCheckTx(code=0)])
+    finally:
+        mp.unlock()
     assert mp.reap_max_txs(10) == [b"\x20b"]
-    # committed tx stays cached: re-adding is a no-op
-    assert mp.check_tx(b"\x10a").log == "tx already in cache"
+    # committed tx stays cached: re-adding is rejected
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(b"\x10a")
     assert mp.size() == 1
+
+
+def test_ttl_expiry_purges_on_update():
+    mp = _mk(maxtxs=10, ttl_num_blocks=2)
+    mp._height = 5
+    assert mp.check_tx(b"\x10old").code == 0
+    mp.lock()
+    try:
+        mp.update(8, [], [])  # height 8: admitted at 5, ttl 2 -> expired
+    finally:
+        mp.unlock()
+    assert mp.size() == 0
+    # and purged from the cache, so it may be resubmitted
+    assert mp.check_tx(b"\x10old").code == 0
 
 
 def test_counter_app_serial_semantics():

@@ -15,7 +15,6 @@ import urllib.request
 
 import pytest
 
-pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
 pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
 
 from tendermint_tpu.config import Config, test_config

@@ -82,7 +82,6 @@ def test_txindex_query_seam():
 def test_psql_indexer_config_accepted():
     import pytest
 
-    pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
     from tendermint_tpu.config import Config
 
     cfg = Config()

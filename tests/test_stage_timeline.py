@@ -25,10 +25,12 @@ def test_marks_monotonic_and_durations_sum():
     times = [t for _, _, t in rec["marks"]]
     assert times == sorted(times)
     # every stage present, each duration >= 0, and the chain of stage
-    # intervals never exceeds the height total
+    # intervals never exceeds the height total (allow half-ulp-per-stage
+    # rounding accumulation: each duration rounds to 1e-6 independently)
     assert set(rec["durations"]) == set(STAGES)
     assert all(d >= 0 for d in rec["durations"].values())
-    assert sum(rec["durations"].values()) <= rec["total_s"] + 1e-6
+    assert sum(rec["durations"].values()) <= \
+        rec["total_s"] + 1e-6 * (len(rec["durations"]) + 1)
     # the reactor's wire mark rides along without entering the durations
     assert ["proposal_wire"] == [m[0] for m in rec["marks"]
                                  if m[0] not in STAGES]

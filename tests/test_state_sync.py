@@ -9,7 +9,6 @@ import os
 
 import pytest
 
-pytest.importorskip("tomllib", reason="config TOML loading needs Python 3.11+ stdlib tomllib")
 pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
 
 from tendermint_tpu import crypto

@@ -226,6 +226,7 @@ def self_test() -> int:
                       "localnet_4node_ingest_txs_per_sec": (24.0, "txs/s"),
                       "localnet_4node_ingest_commit_latency_p99_s":
                           (2.0, "s"),
+                      "localnet_4node_ingest_checktx_p99_s": (0.02, "s"),
                       "verify_commit_10k_breakdown_pack_share":
                           (0.11, "ratio"),
                       "fast_sync_pipeline_breakdown_hash_store_share":
@@ -241,6 +242,7 @@ def self_test() -> int:
                     "localnet_4node_ingest_txs_per_sec": (22.0, "txs/s"),
                     "localnet_4node_ingest_commit_latency_p99_s":
                         (2.3, "s"),
+                    "localnet_4node_ingest_checktx_p99_s": (0.024, "s"),
                     "verify_commit_10k_breakdown_pack_share":
                         (0.13, "ratio"),
                     "fast_sync_pipeline_breakdown_hash_store_share":
@@ -253,7 +255,9 @@ def self_test() -> int:
         _write(ing_bad, {"localnet_4node_ingest_txs_per_sec":
                          (10.0, "txs/s"),
                          "localnet_4node_ingest_commit_latency_p99_s":
-                         (6.0, "s")})
+                         (6.0, "s"),
+                         "localnet_4node_ingest_checktx_p99_s":
+                         (0.2, "s")})
         assert main(["--threshold", "verify_commit_10k_sigs_per_sec=9",
                      "--threshold",
                      "verify_commit_10k_multichip_sigs_per_sec=9",
@@ -267,6 +271,10 @@ def self_test() -> int:
         assert rows["localnet_4node_ingest_txs_per_sec"][
             "status"] == "regressed"
         assert rows["localnet_4node_ingest_commit_latency_p99_s"][
+            "status"] == "regressed"
+        # the admission-latency row gates lower-better like any "s" metric:
+        # a 10x checktx p99 blow-up trips on its own
+        assert rows["localnet_4node_ingest_checktx_p99_s"][
             "status"] == "regressed"
         # (ing_bad also dropped the flagship rows — flagged as missing)
         assert rows["verify_commit_10k_sigs_per_sec"]["status"] == "missing"
@@ -315,6 +323,7 @@ def self_test() -> int:
                      "localnet_4node_ingest_txs_per_sec": (24.0, "txs/s"),
                      "localnet_4node_ingest_commit_latency_p99_s":
                          (2.0, "s"),
+                     "localnet_4node_ingest_checktx_p99_s": (0.02, "s"),
                      "verify_commit_10k_breakdown_pack_share":
                          (0.11, "ratio")})
         assert main([base, bad]) == 1

@@ -45,6 +45,15 @@ MAGIC = b"ltm1"
 #: latency percentiles the report prints (p50/p99/p99.9 are the gate rows)
 PERCENTILES = (0.5, 0.9, 0.99, 0.999)
 
+#: the ingest plane's signed-tx envelope framing (mempool/ingest.py —
+#: kept in sync by its tests): magic | pubkey(32) | fee(8) | nonce(8) |
+#: payload | sig(64). The report strips it so --signed runs recover the
+#: same harness payload from committed blocks; building one needs the
+#: repo's crypto (the only non-stdlib corner besides aiohttp).
+STX_MAGIC = b"stx1"
+_STX_HEADER = 4 + 32 + 8 + 8
+_STX_MIN = _STX_HEADER + 64
+
 
 # -- tx format ----------------------------------------------------------------
 
@@ -63,11 +72,40 @@ def make_tx(size: int, seq: int, send_ns: Optional[int] = None) -> bytes:
     return body
 
 
+def strip_envelope(tx: bytes) -> bytes:
+    """The harness payload inside a signed ingest envelope (or the tx
+    itself when unsigned)."""
+    if tx.startswith(STX_MAGIC) and len(tx) >= _STX_MIN:
+        return tx[_STX_HEADER:-64]
+    return tx
+
+
 def parse_tx(tx: bytes):
+    tx = strip_envelope(tx)
     if not tx.startswith(MAGIC) or len(tx) < 20:
         return None
     send_ns, seq = struct.unpack(">QQ", tx[4:20])
     return send_ns, seq
+
+
+def make_signed_txs(size: int, scheds_ns, fee: int = 1,
+                    n_keys: int = 4) -> list:
+    """Pre-signed envelope txs for every schedule slot, built BEFORE the
+    open-loop clock starts (pure-python ed25519 signing is ~2 ms/tx — on
+    the schedule it would read as node latency). Slots rotate across
+    ``n_keys`` ephemeral senders so per-sender lanes and rate limits see
+    real traffic shape. Needs the repo on PYTHONPATH (only this load
+    path does; the report/parse side stays stdlib)."""
+    # the canonical encoder, not a re-implementation: envelope drift
+    # would otherwise silently turn every signed run into 100% rejects
+    from tendermint_tpu import crypto  # lazy: load path only
+    from tendermint_tpu.mempool.ingest import make_signed_tx
+
+    keys = [crypto.Ed25519PrivKey.generate(
+        struct.pack(">Q", 0x10ad + i) * 4) for i in range(n_keys)]
+    return [make_signed_tx(keys[seq % n_keys], make_tx(size, seq, send_ns),
+                           nonce=seq, fee=fee)
+            for seq, send_ns in enumerate(scheds_ns)]
 
 
 # -- schedule + percentile math ----------------------------------------------
@@ -104,18 +142,28 @@ def _payload(seq: int, tx: bytes) -> bytes:
 
 
 async def open_loop_load(endpoint: str, rate: float, duration: float,
-                         size: int, clients: int = 4) -> dict:
+                         size: int, clients: int = 4,
+                         signed: bool = False) -> dict:
     """Drive ``rate`` tx/s for ``duration`` s through ``clients`` concurrent
     senders. Client c owns schedule slots c, c+clients, ... — a slow
     response delays only that client's later slots, and the report still
     measures every tx from its PLANNED time, so any harness lag shows up
-    as latency (and in ``max_sched_lag_s``), never as hidden load."""
+    as latency (and in ``max_sched_lag_s``), never as hidden load.
+    ``signed`` wraps every tx in the ingest plane's ed25519 envelope
+    (pre-signed before the clock starts)."""
     n = max(1, int(rate * duration))
     clients = max(1, min(clients, n))
-    lead = 0.2  # schedule starts slightly in the future so slot 0 is real
+    # schedule starts in the future so slot 0 is real; signed runs lead
+    # far enough to pre-sign every tx first (pure-python ed25519 ~2 ms/tx
+    # — overruns surface honestly in max_sched_lag_s, never hidden)
+    lead = 0.0035 * n + 0.5 if signed else 0.2
     t0 = time.monotonic() + lead
     wall0 = time.time_ns() + int(lead * 1e9)
     sched = plan_schedule(rate, n, t0)
+    prebuilt = None
+    if signed:
+        prebuilt = make_signed_txs(
+            size, [wall0 + int(i / rate * 1e9) for i in range(n)])
     stats = {"planned": n, "sent": 0, "accepted": 0, "rejected": 0,
              "errors": 0, "max_sched_lag_s": 0.0}
 
@@ -134,8 +182,11 @@ async def open_loop_load(endpoint: str, rate: float, duration: float,
                 else:
                     stats["max_sched_lag_s"] = max(
                         stats["max_sched_lag_s"], now - target)
-                planned_ns = wall0 + int((sched[seq] - t0) * 1e9)
-                tx = make_tx(size, seq, planned_ns)
+                if prebuilt is not None:
+                    tx = prebuilt[seq]
+                else:
+                    planned_ns = wall0 + int((sched[seq] - t0) * 1e9)
+                    tx = make_tx(size, seq, planned_ns)
                 stats["sent"] += 1
                 try:
                     code = await post(seq, tx)
@@ -183,13 +234,14 @@ async def open_loop_load(endpoint: str, rate: float, duration: float,
     stats["duration_s"] = duration
     stats["clients"] = clients
     stats["size_bytes"] = size
+    stats["signed"] = bool(signed)
     return stats
 
 
 def load(endpoint: str, rate: float, duration: float, size: int,
-         clients: int = 4) -> int:
+         clients: int = 4, signed: bool = False) -> int:
     stats = asyncio.run(open_loop_load(endpoint, rate, duration, size,
-                                       clients))
+                                       clients, signed=signed))
     print(json.dumps(stats))
     return 0 if stats["errors"] < stats["planned"] else 1
 
@@ -245,15 +297,22 @@ def summarize_timeline(doc: dict) -> dict:
     records = doc.get("records", [])
     stage_counts: Dict[str, int] = {}
     commit_s = []
+    admission_s = []
     complete = 0
     for rec in records:
-        stages = {m[0] for m in rec.get("marks", [])}
-        for s in stages:
+        marks = {m[0]: m[1] for m in rec.get("marks", [])}
+        for s in marks:
             stage_counts[s] = stage_counts.get(s, 0) + 1
+        if "rpc_received" in marks and "mempool_admitted" in marks:
+            # admission latency: RPC front door -> lane insertion, the
+            # in-node CheckTx-path cost the ingest bench gates as
+            # localnet_4node_ingest_checktx_p99_s
+            admission_s.append(
+                max(0.0, marks["mempool_admitted"] - marks["rpc_received"]))
         if rec.get("terminal") == "committed":
             commit_s.append(rec.get("total_s", 0.0))
             if {"rpc_received", "checktx_done", "mempool_admitted",
-                    "committed"} <= stages:
+                    "committed"} <= marks.keys():
                 complete += 1
     return {
         "records": len(records),
@@ -262,6 +321,7 @@ def summarize_timeline(doc: dict) -> dict:
         "stage_counts": stage_counts,
         "complete_rpc_to_commit_records": complete,
         "node_commit_latency_s": percentiles(commit_s),
+        "admission_latency_s": percentiles(admission_s),
     }
 
 
@@ -282,6 +342,33 @@ def scrape_prom(text: str, wanted_prefixes=("tendermint_mempool_",
             out[series] = float(value)
         except ValueError:
             continue
+    return out
+
+
+#: the series whose reason labels summarize_rejections rolls up: every
+#: way the ingestion plane refuses or drops load (admission-control
+#: sheds, pre-admission failures, post-admission evictions)
+_REJECTION_SERIES = ("tendermint_mempool_shed_txs_total",
+                     "tendermint_mempool_failed_txs",
+                     "tendermint_mempool_evicted_txs_total")
+
+
+def summarize_rejections(metrics: Dict[str, float]) -> Dict[str, dict]:
+    """{series-kind: {reason: count}} from a /metrics scrape — dropped
+    load rendered next to the latency percentiles, so a report can never
+    show a rosy p99 while the node quietly shed half the offered txs."""
+    out: Dict[str, dict] = {}
+    for series, value in metrics.items():
+        name, _, labels = series.partition("{")
+        if name not in _REJECTION_SERIES or not value:
+            continue
+        reason = "total"
+        for part in labels.rstrip("}").split(","):
+            k, _, v = part.partition("=")
+            if k == "reason":
+                reason = v.strip('"')
+        kind = name.rsplit("tendermint_mempool_", 1)[-1]
+        out.setdefault(kind, {})[reason] = value
     return out
 
 
@@ -319,6 +406,7 @@ def report_doc(endpoint: str, metrics_endpoint: Optional[str] = None,
         try:
             with urllib.request.urlopen(metrics_endpoint, timeout=10) as r:
                 doc["metrics"] = scrape_prom(r.read().decode())
+            doc["rejections"] = summarize_rejections(doc["metrics"])
         except Exception as e:
             doc["metrics"] = {"error": f"{type(e).__name__}: {e}"}
     return doc
@@ -369,6 +457,10 @@ def _synthetic_node(n_blocks: int = 4, rate: float = 100.0):
         "# TYPE tendermint_mempool_admitted_txs_total counter",
         "tendermint_mempool_admitted_txs_total %d" % seq,
         'tendermint_mempool_failed_txs{reason="full"} 3',
+        'tendermint_mempool_failed_txs{reason="invalid-sig"} 2',
+        'tendermint_mempool_shed_txs_total{reason="queue-full"} 5',
+        'tendermint_mempool_shed_txs_total{reason="sender-rate"} 0',
+        'tendermint_mempool_evicted_txs_total{reason="priority-evicted"} 1',
         'tendermint_mempool_tx_stage_seconds_bucket{le="+Inf",stage="committed"} 9',
         'tendermint_rpc_request_seconds_count{endpoint="broadcast_tx_sync",outcome="ok"} %d' % seq,
     ]) + "\n"
@@ -419,6 +511,13 @@ def self_test() -> int:
     # two txs with the same seq differ only in send time; different seqs
     # differ in padding too (unique on the wire)
     assert make_tx(64, 1, 5) != make_tx(64, 2, 5)
+    # a signed-envelope wrapping is transparent to the report (stdlib
+    # fake: framing only, no real signature needed to parse)
+    wrapped = STX_MAGIC + b"\xaa" * 32 + struct.pack(">QQ", 1, 7) \
+        + tx + b"\xbb" * 64
+    assert strip_envelope(wrapped) == tx
+    assert parse_tx(wrapped) == (123456789, 7)
+    assert strip_envelope(b"stx1short") == b"stx1short"  # malformed: as-is
 
     # open-loop schedule: exact fixed-rate grid, planned up front
     sched = plan_schedule(50.0, 100, t0=10.0)
@@ -446,12 +545,22 @@ def self_test() -> int:
         assert tlr["complete_rpc_to_commit_records"] == 1, tlr
         assert tlr["stage_counts"]["committed"] == 1
         assert abs(tlr["node_commit_latency_s"]["p50"] - 0.31) < 1e-6
+        # in-node admission latency (rpc_received -> mempool_admitted wall
+        # delta over the timeline records) — the checktx-p99 gate's source
+        adm = tlr["admission_latency_s"]
+        assert abs(adm["p50"] - 0.1) < 1e-6 and abs(adm["p99"] - 0.1) < 1e-6
         mtx = doc["metrics"]
         assert mtx["tendermint_mempool_admitted_txs_total"] == 100.0
         assert mtx['tendermint_mempool_failed_txs{reason="full"}'] == 3.0
         assert not any("_bucket{" in s or s.endswith("_bucket")
                        for s in mtx), \
             "histogram bucket leaked into the scrape"
+        # dropped load is first-class in the report: reason-labeled
+        # sheds/failures/evictions rolled up next to the percentiles
+        rej = doc["rejections"]
+        assert rej["shed_txs_total"] == {"queue-full": 5.0}  # zeros dropped
+        assert rej["failed_txs"] == {"full": 3.0, "invalid-sig": 2.0}
+        assert rej["evicted_txs_total"] == {"priority-evicted": 1.0}
     finally:
         srv.shutdown()
     print("loadtime self-test OK (schedule, percentiles, report, scrapes)")
@@ -472,6 +581,10 @@ def main(argv=None) -> int:
         sp.add_argument("--duration", type=float, default=10.0)
         sp.add_argument("--size", type=int, default=128)
         sp.add_argument("--clients", type=int, default=4)
+        sp.add_argument("--signed", action="store_true",
+                        help="wrap txs in the ingest plane's ed25519 "
+                             "envelope (pre-signed; needs the repo on "
+                             "PYTHONPATH)")
         if name == "run":
             sp.add_argument("--metrics-endpoint", default=None)
             sp.add_argument("--settle", type=float, default=4.0,
@@ -486,10 +599,12 @@ def main(argv=None) -> int:
     if ns.command is None:
         p.error("need a command (load/report/run) or --self-test")
     if ns.command == "load":
-        return load(ns.endpoint, ns.rate, ns.duration, ns.size, ns.clients)
+        return load(ns.endpoint, ns.rate, ns.duration, ns.size, ns.clients,
+                    signed=ns.signed)
     if ns.command == "run":
         stats = asyncio.run(open_loop_load(ns.endpoint, ns.rate, ns.duration,
-                                           ns.size, ns.clients))
+                                           ns.size, ns.clients,
+                                           signed=ns.signed))
         time.sleep(ns.settle)
         doc = report_doc(ns.endpoint, ns.metrics_endpoint)
         doc["load"] = stats
