@@ -43,6 +43,12 @@ def generate_one(rng: random.Random, idx: int) -> Tuple[str, dict]:
         "wait_blocks": rng.choice([4, 5, 6]),
         "node": {},
     }
+    # topology axis: most nets stay full mesh; some run the sparse
+    # persistent-peer graph (gossip must relay) — the churn/scale regime
+    if n_validators >= 3 and rng.random() < 0.3:
+        doc["topology"] = "sparse"
+        doc["sparse_degree"] = rng.choice([2, 3])
+        doc["topology_seed"] = rng.randint(0, 999)
     perturb_budget = 2  # bound wall-clock: at most 2 perturbed nodes per net
     for v in range(n_validators):
         node = {"mode": "validator"}
@@ -74,13 +80,21 @@ def generate_one(rng: random.Random, idx: int) -> Tuple[str, dict]:
         joiner = {"mode": "full", "start_at": rng.randint(5, 8)}
         if rng.random() < 0.5:
             joiner["state_sync"] = True
+        if rng.random() < 0.3:
+            # full churn arc: join late AND leave before the run ends
+            joiner["stop_at"] = joiner["start_at"] + rng.randint(4, 6)
         doc["node"][f"sync{idx}"] = joiner
+    # a genesis full node may leave mid-run (validators keep quorum: the
+    # manifest validator requires >2/3 of power to never stop)
+    if "full0" in doc["node"] and rng.random() < 0.3:
+        doc["node"]["full0"]["stop_at"] = rng.randint(6, 9)
     return doc["chain_id"], doc
 
 
 def doc_to_toml(doc: dict) -> str:
     lines = [f"# generated manifest (tendermint_tpu.e2e.generate)"]
-    for k in ("chain_id", "initial_height", "load_tx_rate", "wait_blocks"):
+    for k in ("chain_id", "initial_height", "load_tx_rate", "wait_blocks",
+              "topology", "sparse_degree", "topology_seed"):
         if k in doc:
             lines.append(f"{k} = {_toml_str(doc[k])}")
     if doc.get("validators"):

@@ -6,6 +6,9 @@ TOML shape:
     initial_height = 1
     load_tx_rate = 2            # txs/sec during the load stage
     wait_blocks = 6             # blocks to wait after perturbations
+    topology = "full_mesh"      # full_mesh | sparse | seed
+    sparse_degree = 3           # sparse: ~persistent peers per node
+    topology_seed = 0           # sparse: chord-graph seed
 
     [validators]                # name -> voting power (defaults: all 4 @ 10)
     validator0 = 10
@@ -17,9 +20,22 @@ TOML shape:
     state_sync = false
     privval = "file"            # file | tcp (remote signer over SecretConn)
     start_at = 0                # join the net after this height (0 = launch)
+    stop_at = 0                 # LEAVE the net at this height (0 = never):
+                                # a clean SIGTERM departure, excluded from
+                                # post-run invariants — the churn schedule
+    seed_node = false           # topology="seed": this node is the
+                                # discovery entry everyone else learns
+                                # peers from (PEX), not a persistent peer
     perturb = ["kill"]          # kill | pause | restart | disconnect
     [node.validator0.misbehaviors]
     3 = "double-prevote"        # height -> misbehavior (maverick hooks)
+
+Topology semantics: ``full_mesh`` lists every other node as a persistent
+peer (the old behavior, and the default). ``sparse`` wires the
+deterministic ring+chords graph from p2p.inproc.sparse_edges — each node
+persistent-dials only its graph neighbors, so gossip must relay. ``seed``
+gives non-seed nodes ONLY config.p2p.seeds (the seed_node entries) and no
+persistent peers: the net assembles itself through PEX discovery.
 
 Perturbation semantics: kill/pause/restart match the reference's
 (test/e2e/runner/perturb.go:28-66). ``disconnect`` is an APPROXIMATION —
@@ -47,6 +63,9 @@ class NodeManifest:
     state_sync: bool = False
     privval: str = "file"              # file | tcp
     start_at: int = 0                  # 0 = start with the net
+    stop_at: int = 0                   # 0 = never leave; else a clean
+                                       # SIGTERM once the net reaches it
+    seed_node: bool = False            # discovery entry (topology="seed")
     perturb: List[str] = field(default_factory=list)
     misbehaviors: Dict[int, str] = field(default_factory=dict)
     # fault-plane arming for this node's subprocess: exported as
@@ -83,6 +102,20 @@ class NodeManifest:
         if self.state_sync and self.start_at == 0:
             raise ValueError(
                 f"{self.name}: state_sync nodes must join later (start_at > 0)")
+        if self.stop_at < 0 or self.start_at < 0:
+            raise ValueError(f"{self.name}: start_at/stop_at must be >= 0")
+        if self.stop_at and self.stop_at <= self.start_at:
+            raise ValueError(
+                f"{self.name}: stop_at ({self.stop_at}) must exceed "
+                f"start_at ({self.start_at}) — a node can't leave before "
+                f"it joins")
+        if self.seed_node and (self.start_at or self.stop_at):
+            raise ValueError(
+                f"{self.name}: a seed node anchors discovery; it can't "
+                f"churn (start_at/stop_at must be 0)")
+
+
+TOPOLOGIES = ("full_mesh", "sparse", "seed")
 
 
 @dataclass
@@ -91,6 +124,9 @@ class Manifest:
     initial_height: int = 1
     load_tx_rate: int = 2
     wait_blocks: int = 6
+    topology: str = "full_mesh"
+    sparse_degree: int = 3
+    topology_seed: int = 0
     validators: Dict[str, int] = field(default_factory=dict)
     nodes: List[NodeManifest] = field(default_factory=list)
 
@@ -112,6 +148,8 @@ class Manifest:
                 state_sync=nd.get("state_sync", False),
                 privval=nd.get("privval", "file"),
                 start_at=int(nd.get("start_at", 0)),
+                stop_at=int(nd.get("stop_at", 0)),
+                seed_node=bool(nd.get("seed_node", False)),
                 perturb=list(nd.get("perturb", [])),
                 misbehaviors={int(h): m
                               for h, m in nd.get("misbehaviors", {}).items()},
@@ -123,6 +161,9 @@ class Manifest:
             initial_height=int(doc.get("initial_height", 1)),
             load_tx_rate=int(doc.get("load_tx_rate", 2)),
             wait_blocks=int(doc.get("wait_blocks", 6)),
+            topology=doc.get("topology", "full_mesh"),
+            sparse_degree=int(doc.get("sparse_degree", 3)),
+            topology_seed=int(doc.get("topology_seed", 0)),
             validators={k: int(v) for k, v in doc.get("validators", {}).items()},
             nodes=nodes,
         )
@@ -144,3 +185,26 @@ class Manifest:
                              if n.mode == "validator" and n.start_at == 0]
         if not launch_validators:
             raise ValueError("need at least one validator at genesis")
+        if self.topology not in TOPOLOGIES:
+            raise ValueError(f"unknown topology {self.topology!r}; "
+                             f"known: {TOPOLOGIES}")
+        if self.sparse_degree < 1:
+            raise ValueError("sparse_degree must be >= 1")
+        if self.topology == "seed" and not any(n.seed_node
+                                               for n in self.nodes):
+            raise ValueError('topology "seed" needs at least one node '
+                             'with seed_node = true')
+        if any(n.seed_node for n in self.nodes) and self.topology != "seed":
+            raise ValueError('seed_node nodes require topology = "seed"')
+        # churn must not drain the quorum: validators that never leave
+        # must hold > 2/3 of genesis power, or the schedule stalls the net
+        powers = self.validators or {
+            n.name: 10 for n in self.nodes if n.mode == "validator"}
+        total = sum(powers.values())
+        staying = sum(p for name, p in powers.items()
+                      if not any(n.name == name and n.stop_at
+                                 for n in self.nodes))
+        if total and staying * 3 <= total * 2:
+            raise ValueError(
+                f"churn schedule drains quorum: validators that never "
+                f"leave hold {staying}/{total} power (need > 2/3)")

@@ -62,6 +62,11 @@ class Runner:
         self.configs: Dict[str, Config] = {}
         self.node_ids: Dict[str, str] = {}
         self.loaded_txs: List[bytes] = []
+        self.departed: set = set()    # clean stop_at leaves (not failures)
+        #: name -> join-to-caught-up seconds for late joiners (the churn
+        #: metric: launch → height >= the net's height at launch time)
+        self.join_stats: Dict[str, float] = {}
+        self._join_marks: Dict[str, tuple] = {}
         self._fleet = None            # FleetScraper while the net runs
         self.fleet_rollup: Optional[dict] = None
         self._log = open(os.path.join(root, "runner.log"), "w") \
@@ -141,13 +146,38 @@ class Runner:
         )
         for i, nm in enumerate(self.m.nodes):
             cfg = self.configs[nm.name]
-            peers = ",".join(
-                f"{self.node_ids[other.name]}@127.0.0.1:{self._ports(j)[0]}"
-                for j, other in enumerate(self.m.nodes)
-                if other.name != nm.name)
-            cfg.p2p.persistent_peers = peers
+            cfg.p2p.persistent_peers = ",".join(
+                self._peer_addr(other) for other in self._peers_of(nm))
+            if self.m.topology == "seed" and not nm.seed_node:
+                cfg.p2p.seeds = ",".join(
+                    self._peer_addr(o) for o in self.m.nodes if o.seed_node)
+            if nm.seed_node:
+                cfg.p2p.seed_mode = True
             genesis.save_as(cfg.genesis_file())
             cfg.save()
+
+    def _peer_addr(self, nm: NodeManifest) -> str:
+        idx = [n.name for n in self.m.nodes].index(nm.name)
+        return f"{self.node_ids[nm.name]}@127.0.0.1:{self._ports(idx)[0]}"
+
+    def _peers_of(self, nm: NodeManifest) -> List[NodeManifest]:
+        """Persistent peers per the manifest topology: every other node
+        (full_mesh), graph neighbors (sparse — the SAME seeded ring+chords
+        graph p2p.inproc.sparse_edges builds for in-proc nets), or nobody
+        (seed — discovery fills the peer set via PEX)."""
+        if self.m.topology == "seed":
+            return []
+        others = [o for o in self.m.nodes if o.name != nm.name]
+        if self.m.topology == "full_mesh":
+            return others
+        from ..p2p.inproc import sparse_edges
+
+        edges = sparse_edges([n.name for n in self.m.nodes],
+                             degree=self.m.sparse_degree,
+                             seed=self.m.topology_seed)
+        mine = {b if a == nm.name else a
+                for a, b in edges if nm.name in (a, b)}
+        return [o for o in others if o.name in mine]
 
     def _env(self, nm: NodeManifest) -> dict:
         env = dict(os.environ)
@@ -211,11 +241,53 @@ class Runner:
             self.wait_for_height(nm.start_at)
             if nm.state_sync:
                 self._point_state_sync(nm)
+            # join-to-caught-up: the clock starts at launch, the target is
+            # the net's height NOW (what "caught up" meant when it joined)
+            self._join_marks[nm.name] = (time.time(), max(1, self.max_height()))
             self._launch(nm)
             if self._fleet is not None:
                 self._fleet.add_endpoint(
                     nm.name,
                     f"http://127.0.0.1:{self._metrics_port(nm.name)}/metrics")
+
+    def measure_join_catchup(self, timeout: float = 180.0) -> Dict[str, float]:
+        """Block until each launched late joiner reaches the height the net
+        held when it was launched; records seconds into join_stats."""
+        for name, (t0, target) in list(self._join_marks.items()):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if self.height(name) >= target:
+                    self.join_stats[name] = round(time.time() - t0, 3)
+                    break
+                time.sleep(0.5)
+            else:
+                raise E2EError(
+                    f"joiner {name} never caught up to h={target}")
+            del self._join_marks[name]
+        return self.join_stats
+
+    def apply_churn_stops(self) -> None:
+        """The leave half of the churn schedule: nodes with stop_at get a
+        clean SIGTERM once the net reaches that height and are excluded
+        from post-run invariants — a scheduled departure is not a dead
+        node. Processed in stop_at order so multi-leave schedules play out
+        deterministically."""
+        for nm in sorted((n for n in self.m.nodes if n.stop_at),
+                         key=lambda n: (n.stop_at, n.name)):
+            proc = self.procs.get(nm.name)
+            if proc is None:
+                continue
+            self.wait_for_height(nm.stop_at)
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            self.procs.pop(nm.name, None)
+            self.departed.add(nm.name)
+            if self._fleet is not None:
+                self._fleet.remove_endpoint(nm.name)
 
     def _point_state_sync(self, nm: NodeManifest) -> None:
         """Fill rpc_servers + trust root from the live net just before the
@@ -488,8 +560,9 @@ class Runner:
     # -- one-call orchestration ----------------------------------------------
 
     def run(self) -> None:
-        """setup → start → load → late joiners → perturb → load → wait →
-        invariants → stop. Raises E2EError on any failed invariant."""
+        """setup → start → load → late joiners (join-to-caught-up timed) →
+        perturb → load → churn leaves (stop_at) → wait → invariants →
+        stop. Raises E2EError on any failed invariant."""
         self.setup()
         try:
             self.start()
@@ -497,8 +570,10 @@ class Runner:
             self.load()
             self.start_late_joiners()
             self.wait_all_alive()
+            self.measure_join_catchup()
             self.perturb()
             self.load()
+            self.apply_churn_stops()
             self.wait_all_alive()
             self.wait()
             self.check_invariants()

@@ -369,9 +369,14 @@ class Node:
         if config.p2p.pex:
             from .p2p.pex import AddrBook, PEXReactor
 
+            # the book shares blocksync's peer-score ledger: a provider
+            # blocksync severe-banned must not keep being redialed (or
+            # re-advertised) by PEX, and mark_bad strikes land where the
+            # sync planes already look
             self.addr_book = AddrBook(
                 config._rootify(config.p2p.addr_book_file),
-                strict=config.p2p.addr_book_strict)
+                strict=config.p2p.addr_book_strict,
+                scoreboard=self.blockchain_reactor.scoreboard)
             self.addr_book.add_our_address(node_key.id)
             # seed the book from config.p2p.seeds (node.go:600 createAddrBook)
             for addr in parse_peer_list(config.p2p.seeds):

@@ -17,7 +17,7 @@ import collections
 import logging
 import random
 import zlib
-from typing import Dict, Iterable, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..libs.faults import faults
 from .base import Peer
@@ -80,6 +80,32 @@ class LinkPolicy:
             delays.append(delay)
         self.stats["delivered"] += copies
         return delays
+
+
+def sparse_edges(ids: List[str], degree: int = 3,
+                 seed: int = 0) -> List[Tuple[str, str]]:
+    """Deterministic connected sparse graph over ``ids``: a ring (so the
+    graph is connected by construction) plus seeded random chords until the
+    average degree reaches ``degree``. Pure — same (ids, degree, seed) →
+    same edge list — so an e2e runner and an in-proc chaos net derive the
+    SAME persistent-peer graph. Returns sorted (a, b) pairs with a < b."""
+    ids = sorted(ids)
+    n = len(ids)
+    if n < 2:
+        return []
+    edges: Set[Tuple[str, str]] = set()
+    for i in range(n):  # the ring
+        a, b = ids[i], ids[(i + 1) % n]
+        if a != b:
+            edges.add((min(a, b), max(a, b)))
+    want = min(n * max(2, degree) // 2, n * (n - 1) // 2)
+    rng = random.Random(zlib.crc32(f"sparse|{seed}|{n}".encode()))
+    attempts = 0
+    while len(edges) < want and attempts < 20 * want:
+        attempts += 1
+        a, b = rng.sample(ids, 2)
+        edges.add((min(a, b), max(a, b)))
+    return sorted(edges)
 
 
 class InProcPeer(Peer):
@@ -175,9 +201,14 @@ class InProcNetwork:
         #: directed links: (src node, dst node) -> the src-owned peer
         #: object whose try_send covers that direction
         self.links: Dict[Tuple[str, str], InProcPeer] = {}
+        #: nodes that left ON PURPOSE (remove_node): excluded from
+        #: reconnect_missing()/connect_all() until they re-join via
+        #: add_node — a clean leave must not read as a link failure
+        self.departed: Set[str] = set()
 
     def add_switch(self, switch: Switch) -> None:
         self.switches[switch.node_id] = switch
+        self.departed.discard(switch.node_id)
 
     async def connect(self, id_a: str, id_b: str) -> None:
         """Create a bidirectional pair and register with both switches."""
@@ -199,6 +230,23 @@ class InProcNetwork:
             for b in ids[i + 1:]:
                 await self.connect(a, b)
 
+    async def connect_topology(self, topology: str = "full_mesh",
+                               degree: int = 3, seed: int = 0) -> int:
+        """Wire the registered switches per ``topology``: ``full_mesh``
+        (every pair) or ``sparse`` (ring + seeded chords, ~``degree`` links
+        per node — the persistent-peer graph shape a 32-node fleet actually
+        runs, where gossip must relay multi-hop). Returns pairs wired."""
+        if topology == "full_mesh":
+            await self.connect_all()
+            return len(self.links) // 2
+        if topology != "sparse":
+            raise ValueError(f"unknown topology {topology!r}")
+        edges = sparse_edges(sorted(self.switches), degree=degree, seed=seed)
+        for a, b in edges:
+            if not self.connected(a, b):
+                await self.connect(a, b)
+        return len(edges)
+
     async def disconnect(self, id_a: str, id_b: str) -> None:
         """Sever the pair in both directions (perturbation support)."""
         sw_a, sw_b = self.switches[id_a], self.switches[id_b]
@@ -211,6 +259,43 @@ class InProcNetwork:
         if pb is not None:
             await sw_b.stop_peer_gracefully(pb)
 
+    # -- live membership -----------------------------------------------------
+
+    async def add_node(self, switch: Switch,
+                       connect_to: Optional[Iterable[str]] = None) -> None:
+        """Register a switch at RUNTIME and wire it into the live net:
+        connect to every current member (full-mesh entry) or, for sparse
+        topologies / discovery entry, only to ``connect_to``. A previously
+        departed id re-joining is un-marked. The switch should already be
+        started (its reactors greet peers via add_peer)."""
+        self.add_switch(switch)
+        targets = (list(connect_to) if connect_to is not None
+                   else [i for i in self.switches if i != switch.node_id])
+        for other in targets:
+            if other == switch.node_id or other not in self.switches:
+                continue
+            if not self.connected(other, switch.node_id):
+                await self.connect(other, switch.node_id)
+
+    async def remove_node(self, node_id: str) -> int:
+        """Depart a node cleanly: sever every link it holds (both
+        directions drained), drop its switch from the registry, and mark it
+        departed so reconnect_missing()/connect_all() stop trying to
+        re-wire it. LinkPolicy objects on SURVIVING links are untouched
+        (their RNG streams keep replaying). Returns pairs severed. The
+        caller still owns stopping the node's own switch/consensus."""
+        pairs = sorted({tuple(sorted(k)) for k in self.links
+                        if node_id in k})
+        for id_a, id_b in pairs:
+            if id_a in self.switches and id_b in self.switches:
+                await self.disconnect(id_a, id_b)
+            else:  # counterpart already gone: just drop the stale entries
+                self.links.pop((id_a, id_b), None)
+                self.links.pop((id_b, id_a), None)
+        self.switches.pop(node_id, None)
+        self.departed.add(node_id)
+        return len(pairs)
+
     def connected(self, id_a: str, id_b: str) -> bool:
         """Both switches hold a live peer object for the other side."""
         return (id_b in self.switches[id_a].peers
@@ -222,10 +307,17 @@ class InProcNetwork:
         (stop_peer_for_error); without this, adversarial chaos runs bleed
         connectivity until the net partitions itself. Existing LinkPolicy
         objects (and their RNG streams) carry over to the fresh peers so a
-        seeded chaos schedule survives reconnects. Returns pairs rewired."""
+        seeded chaos schedule survives reconnects. Intentionally-departed
+        nodes (remove_node) are skipped — redialing them would make clean
+        leave impossible and mask real link failures in chaos stats.
+        Returns pairs rewired."""
         count = 0
         pairs = {tuple(sorted(k)) for k in self.links}
         for id_a, id_b in sorted(pairs):
+            if id_a in self.departed or id_b in self.departed:
+                continue
+            if id_a not in self.switches or id_b not in self.switches:
+                continue
             if self.connected(id_a, id_b):
                 continue
             pol_ab = self.links.get((id_a, id_b))

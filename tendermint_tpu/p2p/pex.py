@@ -91,11 +91,19 @@ class _KnownAddress:
 
 
 class AddrBook:
-    """(p2p/pex/addrbook.go AddrBook)"""
+    """(p2p/pex/addrbook.go AddrBook)
 
-    def __init__(self, file_path: str = "", strict: bool = True):
+    ``scoreboard`` (a libs.peerscore.PeerScoreboard, optional) ties the
+    book into the sync planes' shared ban ledger: ``mark_bad`` strikes it
+    severely, and ``pick_address``/``get_selection`` exclude banned /
+    backing-off peers — so PEX can't keep redialing (or advertising) a
+    peer blocksync already severe-banned."""
+
+    def __init__(self, file_path: str = "", strict: bool = True,
+                 scoreboard=None):
         self.file_path = file_path
         self.strict = strict
+        self.scoreboard = scoreboard
         self._addrs: Dict[str, _KnownAddress] = {}
         self._our_ids: set = set()
         if file_path and os.path.exists(file_path):
@@ -140,8 +148,20 @@ class AddrBook:
             k.last_success = time.time()
             k.bucket = "old"
 
-    def mark_bad(self, node_id: str) -> None:
+    def mark_bad(self, node_id: str, reason: str = "addrbook") -> None:
+        """Drop the address AND strike the shared scoreboard (severe: the
+        caller has decided this peer is bad, not merely slow) so the sync
+        planes and PEX agree the peer is off-limits."""
         self._addrs.pop(node_id, None)
+        if self.scoreboard is not None:
+            self.scoreboard.record_failure(node_id, reason, severe=True)
+
+    def _usable(self, node_id: str) -> bool:
+        """Scoreboard gate for handing out / dialing an address: banned or
+        backing-off peers are excluded (blocksync/statesync verdicts bind
+        PEX too)."""
+        sb = self.scoreboard
+        return sb is None or not (sb.banned(node_id) or sb.in_backoff(node_id))
 
     def size(self) -> int:
         return len(self._addrs)
@@ -151,9 +171,12 @@ class AddrBook:
 
     def get_selection(self, limit: int = MAX_ADDRS_PER_MSG) -> List[NetAddress]:
         """Random sample biased toward proven (old-bucket) addresses
-        (addrbook.go GetSelectionWithBias shape)."""
-        old = [k.addr for k in self._addrs.values() if k.bucket == "old"]
-        new = [k.addr for k in self._addrs.values() if k.bucket == "new"]
+        (addrbook.go GetSelectionWithBias shape); scoreboard-banned /
+        backing-off peers are never advertised."""
+        old = [k.addr for k in self._addrs.values()
+               if k.bucket == "old" and self._usable(k.addr.id)]
+        new = [k.addr for k in self._addrs.values()
+               if k.bucket == "new" and self._usable(k.addr.id)]
         random.shuffle(old)
         random.shuffle(new)
         take_old = min(len(old), -(-limit * 2 // 3))  # ceil: bias to old
@@ -163,9 +186,11 @@ class AddrBook:
     def pick_address(self, exclude=()) -> Optional[NetAddress]:
         """A random dialable address, preferring fewer failed attempts;
         ``exclude`` filters already-connected/self ids BEFORE pooling (a
-        stable sort over unusable entries must not starve fresh ones)."""
+        stable sort over unusable entries must not starve fresh ones), and
+        scoreboard-banned / backing-off peers are filtered the same way."""
         cands = sorted((k for k in self._addrs.values()
-                        if k.addr.id not in exclude),
+                        if k.addr.id not in exclude
+                        and self._usable(k.addr.id)),
                        key=lambda k: k.attempts)
         if not cands:
             return None
@@ -190,17 +215,27 @@ class AddrBook:
         os.replace(tmp, self.file_path)
 
     def _load(self) -> None:
+        """A corrupted/truncated book file loads as EMPTY with a warning —
+        never a crash at node start (the book is a cache, the net refills
+        it), and never a half-parsed book (entries staged, committed only
+        when the whole document decodes)."""
         try:
             with open(self.file_path) as f:
                 doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError(f"expected a JSON object, got "
+                                 f"{type(doc).__name__}")
+            staged: Dict[str, _KnownAddress] = {}
             for a in doc.get("addrs", []):
                 k = _KnownAddress(NetAddress(a["id"], a["host"], a["port"]),
                                   a.get("src", ""), a.get("attempts", 0),
                                   bucket=a.get("bucket", "new"),
                                   last_success=a.get("last_success", 0.0))
-                self._addrs[k.addr.id] = k
+                staged[k.addr.id] = k
+            self._addrs.update(staged)
         except Exception as e:
-            logger.warning("addrbook load failed: %s", e)
+            logger.warning("addrbook %s unreadable (%s); starting with an "
+                           "empty book", self.file_path, e)
 
 
 def _routable(addr: NetAddress) -> bool:

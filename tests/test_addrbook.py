@@ -1,0 +1,181 @@
+"""AddrBook under churn: persistence round-trips, eviction order, corrupted
+book files, and the shared-scoreboard integration (mark_bad strikes the
+sync planes' ledger; banned/backing-off peers are never picked or
+advertised — PEX can't keep redialing a peer blocksync severe-banned).
+"""
+
+import json
+import logging
+import os
+
+from tendermint_tpu.libs.peerscore import PeerScoreboard
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.pex import NEW_BUCKET_CAP, AddrBook
+
+
+def _addr(i, port=26656):
+    return NetAddress(f"peer{i:04d}", f"10.0.{i // 256}.{i % 256}", port)
+
+
+# -- persistence --------------------------------------------------------------
+
+def test_save_load_round_trip(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path)
+    for i in range(5):
+        assert book.add_address(_addr(i), src_id="seed")
+    book.mark_good("peer0000")          # graduates to the old bucket
+    book.mark_attempt(_addr(1))
+    book.save()
+
+    loaded = AddrBook(path)
+    assert loaded.size() == 5
+    assert loaded.has("peer0000") and loaded.has("peer0004")
+    assert loaded._addrs["peer0000"].bucket == "old"
+    assert loaded._addrs["peer0001"].attempts == 1
+    assert loaded._addrs["peer0002"].bucket == "new"
+    # a second round-trip is stable
+    loaded.save()
+    again = AddrBook(path)
+    assert {k: (v.bucket, v.attempts) for k, v in again._addrs.items()} \
+        == {k: (v.bucket, v.attempts) for k, v in loaded._addrs.items()}
+
+
+def test_corrupted_book_loads_empty_with_warning(tmp_path, caplog):
+    """A truncated/garbled book file must load as empty-with-warning —
+    never crash node start, never half-load."""
+    for i, payload in enumerate((
+            b"{\"addrs\": [{\"id\": \"x\", \"ho",          # truncated JSON
+            b"\x00\x01\x02 not json at all",                # binary garbage
+            b"[1, 2, 3]",                                   # wrong shape
+            json.dumps({"addrs": [
+                {"id": "good", "host": "1.2.3.4", "port": 1},
+                {"id": "bad-entry"},                        # missing fields
+            ]}).encode(),
+    )):
+        path = str(tmp_path / f"book{i}.json")
+        with open(path, "wb") as f:
+            f.write(payload)
+        with caplog.at_level(logging.WARNING, logger="tmtpu.p2p.pex"):
+            caplog.clear()
+            book = AddrBook(path)
+        assert book.size() == 0, f"case {i} half-loaded"
+        assert any("unreadable" in r.message for r in caplog.records), \
+            f"case {i} loaded silently"
+        # the damaged book still works (and can be re-saved over the junk)
+        assert book.add_address(_addr(1))
+        book.save()
+        assert AddrBook(path).size() == 1
+
+
+def test_missing_file_is_not_an_error(tmp_path):
+    book = AddrBook(str(tmp_path / "never-written.json"))
+    assert book.size() == 0
+
+
+# -- eviction -----------------------------------------------------------------
+
+def test_new_bucket_eviction_order():
+    """At the cap, the most-failed never-succeeded address is evicted
+    first; proven (old-bucket) addresses are untouched."""
+    book = AddrBook(strict=False)
+    for i in range(NEW_BUCKET_CAP):
+        assert book.add_address(_addr(i))
+    # peer0001 has failed 5 times: the designated victim
+    for _ in range(5):
+        book.mark_attempt(_addr(1))
+    book.mark_good("peer0000")  # old bucket: not an eviction candidate
+    # graduating peer0000 freed a new-bucket slot: this add fills it back
+    # to the cap without evicting anyone
+    assert book.add_address(_addr(NEW_BUCKET_CAP + 1))
+    assert book.has("peer0001")
+    # at the cap again: the next add evicts the most-failed new entry
+    assert book.add_address(_addr(NEW_BUCKET_CAP + 2))
+    assert not book.has("peer0001"), "most-failed entry survived eviction"
+    assert book.has("peer0000")
+    assert book.has(f"peer{NEW_BUCKET_CAP + 2:04d}")
+    # ...and the next eviction takes the next-most-failed
+    for _ in range(3):
+        book.mark_attempt(_addr(2))
+    assert book.add_address(_addr(NEW_BUCKET_CAP + 3))
+    assert not book.has("peer0002")
+
+
+def test_duplicates_self_and_unroutable_refused():
+    book = AddrBook(strict=True)
+    book.add_our_address("me")
+    assert not book.add_address(NetAddress("me", "1.2.3.4", 1))
+    assert book.add_address(_addr(1))
+    assert not book.add_address(_addr(1))  # duplicate
+    assert not book.add_address(NetAddress("z", "0.0.0.0", 1))
+    assert not book.add_address(NetAddress("z", "1.2.3.4", 0))
+
+
+# -- scoreboard integration ---------------------------------------------------
+
+def test_mark_bad_strikes_shared_scoreboard():
+    sb = PeerScoreboard(ban_threshold=3, name="blocksync")
+    book = AddrBook(strict=False, scoreboard=sb)
+    book.add_address(_addr(1))
+    book.mark_bad("peer0001", reason="bad_block")
+    assert not book.has("peer0001")
+    # severe strike: banned instantly, with the reason recorded
+    assert sb.banned("peer0001")
+    assert sb.snapshot()["peer0001"]["ban_reason"] == "bad_block"
+
+
+def test_banned_peers_never_picked_or_advertised():
+    """A peer blocksync severe-banned is invisible to pick_address AND
+    get_selection, even while its address is still in the book."""
+    sb = PeerScoreboard(ban_threshold=1, name="blocksync")
+    book = AddrBook(strict=False, scoreboard=sb)
+    for i in range(6):
+        book.add_address(_addr(i))
+        book.mark_good(f"peer{i:04d}")
+    sb.record_failure("peer0002", "bad_block", severe=True)
+    assert sb.banned("peer0002")
+    for _ in range(50):
+        pick = book.pick_address()
+        assert pick is not None and pick.id != "peer0002"
+    for _ in range(10):
+        assert "peer0002" not in {a.id for a in book.get_selection()}
+    # the entry itself survives (bans are the scoreboard's verdict; the
+    # address may be re-admitted if the ledger is reset)
+    assert book.has("peer0002")
+
+
+def test_backoff_excludes_then_readmits():
+    """A backing-off (not banned) peer is excluded until its window ends —
+    driven through a fake clock so the test owns time."""
+    clock = [100.0]
+    sb = PeerScoreboard(ban_threshold=5, backoff_base_s=10.0, jitter=0.0,
+                        clock=lambda: clock[0])
+    book = AddrBook(strict=False, scoreboard=sb)
+    book.add_address(_addr(1))
+    book.add_address(_addr(2))
+    sb.record_failure("peer0001", "timeout")
+    assert sb.in_backoff("peer0001")
+    for _ in range(20):
+        assert book.pick_address().id == "peer0002"
+    assert {a.id for a in book.get_selection()} == {"peer0002"}
+    clock[0] += 11.0  # backoff expired: re-admitted
+    assert not sb.in_backoff("peer0001")
+    assert "peer0001" in {book.pick_address().id for _ in range(50)}
+
+
+def test_all_usable_excluded_returns_none():
+    sb = PeerScoreboard(ban_threshold=1)
+    book = AddrBook(strict=False, scoreboard=sb)
+    book.add_address(_addr(1))
+    sb.record_failure("peer0001", "lies", severe=True)
+    assert book.pick_address() is None
+    assert book.get_selection() == []
+
+
+def test_book_without_scoreboard_unchanged():
+    book = AddrBook(strict=False)
+    book.add_address(_addr(1))
+    book.mark_bad("peer0001")
+    assert not book.has("peer0001")
+    book.add_address(_addr(2))
+    assert book.pick_address().id == "peer0002"

@@ -1,0 +1,160 @@
+"""Manifest-level churn plane: topology knobs (full_mesh / sparse / seed),
+the per-node start_at/stop_at churn schedule, quorum-drain validation, the
+runner's topology-aware persistent-peer wiring, and the generator's new
+axes. Pure parsing/wiring — runs in slim containers (no TCP transport)."""
+
+import os
+
+import pytest
+
+from tendermint_tpu.e2e.generate import doc_to_toml, generate
+from tendermint_tpu.e2e.manifest import Manifest
+from tendermint_tpu.libs import toml_compat
+from tendermint_tpu.p2p.inproc import sparse_edges
+
+
+def _doc(**top):
+    doc = {"node": {f"validator{i}": {"mode": "validator"}
+                    for i in range(4)}}
+    doc.update(top)
+    return doc
+
+
+# -- manifest fields + validation ---------------------------------------------
+
+def test_topology_defaults_and_round_trip():
+    m = Manifest.from_doc(_doc())
+    assert (m.topology, m.sparse_degree, m.topology_seed) \
+        == ("full_mesh", 3, 0)
+    m = Manifest.from_doc(_doc(topology="sparse", sparse_degree=2,
+                               topology_seed=9))
+    assert (m.topology, m.sparse_degree, m.topology_seed) == ("sparse", 2, 9)
+
+
+def test_unknown_topology_rejected():
+    with pytest.raises(ValueError, match="unknown topology"):
+        Manifest.from_doc(_doc(topology="star"))
+    with pytest.raises(ValueError, match="sparse_degree"):
+        Manifest.from_doc(_doc(topology="sparse", sparse_degree=0))
+
+
+def test_stop_at_churn_schedule_fields():
+    doc = _doc()
+    doc["node"]["full0"] = {"mode": "full", "stop_at": 7}
+    doc["node"]["sync0"] = {"mode": "full", "start_at": 4, "stop_at": 9}
+    m = Manifest.from_doc(doc)
+    by = {n.name: n for n in m.nodes}
+    assert by["full0"].stop_at == 7
+    assert (by["sync0"].start_at, by["sync0"].stop_at) == (4, 9)
+
+
+def test_stop_before_start_rejected():
+    doc = _doc()
+    doc["node"]["sync0"] = {"mode": "full", "start_at": 5, "stop_at": 5}
+    with pytest.raises(ValueError, match="must exceed"):
+        Manifest.from_doc(doc)
+    doc["node"]["sync0"] = {"mode": "full", "start_at": -1}
+    with pytest.raises(ValueError, match=">= 0"):
+        Manifest.from_doc(doc)
+
+
+def test_churn_quorum_drain_rejected():
+    """Validators scheduled to leave may not take >=1/3 of genesis power
+    with them — the schedule itself would stall the net."""
+    doc = _doc()
+    doc["node"]["validator3"]["stop_at"] = 8
+    doc["node"]["validator2"]["stop_at"] = 9
+    with pytest.raises(ValueError, match="drains quorum"):
+        Manifest.from_doc(doc)
+    # one leaving validator out of four holds 1/4 < 1/3: fine
+    del doc["node"]["validator2"]["stop_at"]
+    m = Manifest.from_doc(doc)
+    assert any(n.stop_at for n in m.nodes)
+
+
+def test_seed_topology_needs_a_seed_node():
+    with pytest.raises(ValueError, match="seed_node = true"):
+        Manifest.from_doc(_doc(topology="seed"))
+    doc = _doc(topology="seed")
+    doc["node"]["seed0"] = {"mode": "full", "seed_node": True}
+    m = Manifest.from_doc(doc)
+    assert [n.name for n in m.nodes if n.seed_node] == ["seed0"]
+    # seed_node outside seed topology is a config smell: rejected
+    doc2 = _doc()
+    doc2["node"]["seed0"] = {"mode": "full", "seed_node": True}
+    with pytest.raises(ValueError, match='topology = "seed"'):
+        Manifest.from_doc(doc2)
+    # a seed node can't churn — it anchors discovery
+    doc3 = _doc(topology="seed")
+    doc3["node"]["seed0"] = {"mode": "full", "seed_node": True, "stop_at": 5}
+    with pytest.raises(ValueError, match="can't churn"):
+        Manifest.from_doc(doc3)
+
+
+# -- runner wiring (no processes launched) ------------------------------------
+
+def _runner_for(doc):
+    from tendermint_tpu.e2e.runner import Runner
+
+    m = Manifest.from_doc(doc)
+    r = Runner(m, root="/nonexistent-churn-test")  # no setup() call
+    r.node_ids = {n.name: f"id-{n.name}" for n in m.nodes}
+    return m, r
+
+def test_runner_full_mesh_peers():
+    m, r = _runner_for(_doc())
+    nm = m.nodes[0]
+    peers = {p.name for p in r._peers_of(nm)}
+    assert peers == {n.name for n in m.nodes} - {nm.name}
+
+
+def test_runner_sparse_peers_match_shared_graph():
+    """The subprocess runner derives persistent peers from the SAME
+    seeded graph the in-proc plane builds — one topology, two planes."""
+    doc = _doc(topology="sparse", sparse_degree=2, topology_seed=4)
+    for i in range(4):
+        doc["node"][f"full{i}"] = {"mode": "full"}
+    m, r = _runner_for(doc)
+    names = [n.name for n in m.nodes]
+    edges = sparse_edges(names, degree=2, seed=4)
+    for nm in m.nodes:
+        want = {b if a == nm.name else a
+                for a, b in edges if nm.name in (a, b)}
+        assert {p.name for p in r._peers_of(nm)} == want
+    # sparse really is sparse at this size
+    assert len(edges) < len(names) * (len(names) - 1) // 2
+
+
+def test_runner_seed_topology_no_persistent_peers():
+    doc = _doc(topology="seed")
+    doc["node"]["seed0"] = {"mode": "full", "seed_node": True}
+    m, r = _runner_for(doc)
+    for nm in m.nodes:
+        assert r._peers_of(nm) == []
+
+
+# -- generator ----------------------------------------------------------------
+
+def test_generator_emits_topology_and_stop_at_and_validates():
+    """Across many seeds the generator samples sparse topologies and
+    stop_at schedules, and every emitted manifest round-trips through the
+    TOML writer+parser and validates."""
+    saw_sparse = saw_stop = False
+    for seed in range(40):
+        for _name, m, toml_text in generate(seed, 3):
+            again = Manifest.from_doc(toml_compat.loads(toml_text))
+            assert again.topology == m.topology
+            saw_sparse |= m.topology == "sparse"
+            saw_stop |= any(n.stop_at for n in m.nodes)
+    assert saw_sparse, "generator never sampled a sparse topology"
+    assert saw_stop, "generator never sampled a stop_at leave"
+
+
+def test_doc_to_toml_writes_topology_keys():
+    doc = _doc(topology="sparse", sparse_degree=2, topology_seed=7)
+    doc["chain_id"] = "t"
+    text = doc_to_toml(doc)
+    assert 'topology = "sparse"' in text
+    assert "sparse_degree = 2" in text
+    parsed = toml_compat.loads(text)
+    assert parsed["topology_seed"] == 7

@@ -1,0 +1,234 @@
+"""The churn-tolerant network plane: live add/remove of in-proc nodes,
+departed-node exclusion from the redial loop, sparse topologies, and the
+churn orchestrator's deterministic plan (tools/churn.py).
+
+The heavyweight end-to-end churn scenarios (statesync joins under load,
+validator rotation across prune boundaries, 32-node chaos) live in the
+chaos matrix (churn.flap / churn.rotate / churn.partition32 /
+churn.corrupt32) and the bench churn config; this file keeps the tier-1
+coverage: membership mechanics on real consensus nets at small N, and the
+pure planning/graph functions at every N.
+"""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+from tendermint_tpu.p2p import InProcNetwork
+from tendermint_tpu.p2p.inproc import sparse_edges
+
+from test_consensus_net import make_net, wait_all_height
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import churn  # noqa: E402  (tools/churn.py — plan/graph functions)
+
+
+# -- sparse_edges: the shared persistent-peer graph ---------------------------
+
+def test_sparse_edges_deterministic_connected_bounded():
+    ids = [f"n{i:02d}" for i in range(32)]
+    e1 = sparse_edges(ids, degree=4, seed=7)
+    assert e1 == sparse_edges(ids, degree=4, seed=7)
+    assert e1 != sparse_edges(ids, degree=4, seed=8)
+    # connected (ring by construction) and near-target average degree
+    adj = {}
+    for a, b in e1:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    seen, stack = {ids[0]}, [ids[0]]
+    while stack:
+        for nxt in adj[stack.pop()]:
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    assert seen == set(ids)
+    avg = sum(len(v) for v in adj.values()) / len(adj)
+    assert 3.0 <= avg <= 5.0, avg
+    # shuffled input produces the same canonical edge list
+    import random
+
+    shuffled = list(ids)
+    random.Random(1).shuffle(shuffled)
+    assert sparse_edges(shuffled, degree=4, seed=7) == e1
+
+
+def test_sparse_edges_small_inputs():
+    assert sparse_edges([], degree=3) == []
+    assert sparse_edges(["solo"], degree=3) == []
+    assert sparse_edges(["a", "b"], degree=3) == [("a", "b")]
+
+
+# -- live membership on a real consensus net ----------------------------------
+
+def test_remove_node_clean_leave_and_rejoin():
+    """A departed node: links drained, excluded from reconnect_missing,
+    survivors keep committing; a later add_node re-admits it and it
+    catches back up to the live net."""
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            await wait_all_height(nodes, 2)
+            # clean leave of node3 (3/4 voting power keeps quorum)
+            severed = await net.remove_node("node3")
+            assert severed == 3
+            assert "node3" in net.departed
+            assert "node3" not in net.switches
+            assert not any("node3" in k for k in net.links)
+            # the redial loop must NOT resurrect it
+            assert await net.reconnect_missing() == 0
+            survivors = nodes[:3]
+            for nd in survivors:
+                assert "node3" not in nd.switch.peers
+            h0 = min(nd.cs.state.last_block_height for nd in survivors)
+            await wait_all_height(survivors, h0 + 2)
+            # re-join: add_node wires it back to everyone and it catches up
+            await net.add_node(nodes[3].switch)
+            assert "node3" not in net.departed
+            target = max(nd.cs.state.last_block_height for nd in survivors) + 2
+            await wait_all_height(nodes, target, timeout=60)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        common = min(nd.cs.state.last_block_height for nd in nodes) - 1
+        hashes = {nd.block_store.load_block_meta(common).header.hash()
+                  for nd in nodes}
+        assert len(hashes) == 1
+
+    asyncio.run(run())
+
+
+def test_remove_node_preserves_surviving_link_policies():
+    """A leave must not disturb surviving links' chaos policies (their
+    seeded RNG streams carry the replay schedule)."""
+    async def run():
+        nodes = make_net(4)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            net.set_loss(0.05, seed=3)
+            pol = net.links[("node0", "node1")].policy
+            await net.remove_node("node2")
+            assert net.links[("node0", "node1")].policy is pol
+            # departed node's policies are gone with its links
+            assert not any("node2" in k for k in net.links)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+
+    asyncio.run(run())
+
+
+def test_reconnect_missing_still_heals_real_failures():
+    """The departed-exclusion must not mask REAL link failures: a severed
+    (not departed) pair is still redialed."""
+    async def run():
+        nodes = make_net(3)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        await net.connect_all()
+        try:
+            # sever the way chaos does: one side drops the peer for an
+            # error (the link registry entry survives, unlike disconnect())
+            sw0 = net.switches["node0"]
+            await sw0.stop_peer_for_error(sw0.peers["node1"], "test sever")
+            assert not net.connected("node0", "node1")
+            assert await net.reconnect_missing() == 1
+            assert net.connected("node0", "node1")
+        finally:
+            for nd in nodes:
+                await nd.stop()
+
+    asyncio.run(run())
+
+
+def test_sparse_topology_net_commits():
+    """A 6-node ring+chords net (gossip must relay — no direct link
+    between every pair) reaches consensus with identical hashes."""
+    async def run():
+        nodes = make_net(6)
+        net = InProcNetwork()
+        for nd in nodes:
+            net.add_switch(nd.switch)
+        for nd in nodes:
+            await nd.start()
+        pairs = await net.connect_topology("sparse", degree=2, seed=5)
+        assert pairs < 15, "sparse graph degenerated into a full mesh"
+        try:
+            await wait_all_height(nodes, 3, timeout=90)
+        finally:
+            for nd in nodes:
+                await nd.stop()
+        hashes = {nd.block_store.load_block_meta(2).header.hash()
+                  for nd in nodes}
+        assert len(hashes) == 1
+
+    asyncio.run(run())
+
+
+def test_connect_topology_rejects_unknown():
+    async def run():
+        net = InProcNetwork()
+        with pytest.raises(ValueError):
+            await net.connect_topology("star")
+
+    asyncio.run(run())
+
+
+# -- the churn plan (pure) ----------------------------------------------------
+
+def test_plan_churn_deterministic_and_quorum_safe():
+    p1 = churn.plan_churn(11, 4, 8)
+    assert p1 == churn.plan_churn(11, 4, 8)
+    assert p1 != churn.plan_churn(12, 4, 8)
+    vset = set(p1["compositions"][0])
+    comp_i = 1
+    for ev in p1["events"]:
+        # a leave never names a sitting validator, and the anchor (val0,
+        # the statesync donor) never rotates out
+        assert ev.get("leave") not in vset
+        if "rotate_in" in ev:
+            assert ev["rotate_out"] != "val0"
+            vset = set(p1["compositions"][comp_i])
+            comp_i += 1
+        assert ev["join"] == f"join{ev['interval']}"
+    assert all(len(c) == churn.N_VALIDATORS for c in p1["compositions"])
+
+
+def test_schedule_fingerprint_excludes_wallclock():
+    rep = {"executed": [("leave", "full0"), ("join", "join0")],
+           "compositions": [["val0"]], "plan": {"events": []},
+           "elapsed_s": 9.9, "blocks_per_min": 14.2,
+           "join_caughtup_s": {"join0": 3.3}}
+    fp = churn.schedule_fingerprint(rep)
+    assert set(fp) == {"executed", "compositions", "plan"}
+
+
+# -- the full churn scenario (slow tier) --------------------------------------
+
+@pytest.mark.slow
+def test_churn_run_n8_with_rotation():
+    """The acceptance scenario end to end: a seeded N=8 run — statesync
+    join + clean leave per interval under open-loop load, validator
+    rotation crossing prune boundaries — completes with all its internal
+    invariants (survivor app-hash agreement, joiners caught up, retained
+    heights resolvable, bounded book/scoreboard state)."""
+    report = churn.run_churn(n_nodes=8, intervals=2, seed=1)
+    assert report["rotations"] == 2
+    assert set(report["join_caughtup_s"]) == {"join0", "join1"}
+    assert report["height_final"] > report["height_initial"]

@@ -483,3 +483,68 @@ def test_state_store_window_batch_reads_own_writes():
             assert ss.load_validators(5) is not None
     # flushed: visible without the buffer
     assert ss.load_validators(5) is not None
+
+
+def _churn_valset(round_: int, n: int = 4):
+    """A validator set for rotation round `round_` built on the repo's own
+    ed25519 (no optional deps): a sliding window over a deterministic key
+    pool, so every round the composition really changes."""
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    vals = []
+    for i in range(round_, round_ + n):
+        pub = crypto.Ed25519PrivKey.generate(
+            bytes([0x30 + (i % 64)]) * 32).pub_key()
+        vals.append(Validator(pub.address(), pub, 10))
+    return ValidatorSet(vals)
+
+
+def test_prune_states_under_continuous_validator_churn():
+    """The churn acceptance path for the prune-checkpointed validator
+    storage: the set rotates EVERY height for 60 heights while
+    prune_states runs concurrently (per save, like a retention-configured
+    node), and load_validators must resolve the CORRECT composition at
+    every retained height — change pointers, interval materialization,
+    prune-floor checkpoints and the rotation all interleaving."""
+    ss = StateStore(MemDB())
+    retain_window = 9
+    expected = {}  # height -> set of validator addresses
+    for h in range(1, 61):
+        vs = _churn_valset(h)
+        ss._save_validators(h, vs, last_changed=h)  # rotates every height
+        expected[h] = {v.address for v in vs.validators}
+        if h > retain_window:
+            ss.prune_states(h - retain_window)
+        floor = max(1, h - retain_window)
+        for rh in range(floor, h + 1):
+            got = ss.load_validators(rh)
+            assert got is not None, \
+                f"retained height {rh} unloadable at tip {h}"
+            assert {v.address for v in got.validators} == expected[rh], \
+                f"wrong composition at {rh} (tip {h})"
+        # pruned heights are really gone (no silent unbounded growth)
+        if floor > 2:
+            assert ss.load_validators(floor - 2) is None
+
+
+def test_prune_states_churn_with_pointer_runs():
+    """Same stress with CHANGE-POINTER runs between rotations (the set
+    holds still for a few heights, then flips): pointers must keep
+    resolving across prune floors that land mid-run."""
+    ss = StateStore(MemDB())
+    retain_window = 7
+    expected = {}
+    change_h, current = 1, _churn_valset(0)
+    for h in range(1, 50):
+        if h % 5 == 0:  # rotation every 5th height
+            current, change_h = _churn_valset(h), h
+        rolled = current.copy_increment_proposer_priority(h - change_h) \
+            if h > change_h else current
+        ss._save_validators(h, rolled, last_changed=change_h)
+        expected[h] = {v.address for v in current.validators}
+        if h > retain_window:
+            ss.prune_states(h - retain_window)
+        for rh in range(max(1, h - retain_window), h + 1):
+            got = ss.load_validators(rh)
+            assert got is not None, f"height {rh} unloadable at tip {h}"
+            assert {v.address for v in got.validators} == expected[rh]

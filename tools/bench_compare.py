@@ -388,6 +388,44 @@ def self_test() -> int:
                      "--threshold",
                      "localnet_4node_tx_commit_latency_p50=2.0",
                      base, bad]) == 0
+        # the churn-plane rows gate like any throughput/latency pair: a
+        # collapsed blocks/min under churn and a join-to-caught-up blow-up
+        # each trip exit 1, a vanished row fails on its own, and per-metric
+        # threshold overrides loosen both gates
+        ch_base = os.path.join(d, "churn_base.json")
+        _write(ch_base, {"inproc_churn8_blocks_per_min":
+                         (14.0, "blocks/min"),
+                         "inproc_churn8_join_caughtup_s": (8.0, "s")})
+        ch_bad = os.path.join(d, "churn_bad.json")
+        _write(ch_bad, {"inproc_churn8_blocks_per_min": (5.0, "blocks/min"),
+                        "inproc_churn8_join_caughtup_s": (30.0, "s")})
+        assert main([ch_base, ch_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ch_base), load_bench(ch_bad), {})}
+        assert rows["inproc_churn8_blocks_per_min"]["status"] == "regressed"
+        assert rows["inproc_churn8_join_caughtup_s"]["status"] == "regressed"
+        ch_gone = os.path.join(d, "churn_gone.json")
+        _write(ch_gone, {"inproc_churn8_blocks_per_min":
+                         (14.0, "blocks/min")})
+        assert main([ch_base, ch_gone]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ch_base), load_bench(ch_gone), {})}
+        assert rows["inproc_churn8_join_caughtup_s"]["status"] == "missing"
+        assert main(["--threshold", "inproc_churn8_blocks_per_min=0.9",
+                     "--threshold", "inproc_churn8_join_caughtup_s=9",
+                     ch_base, ch_bad]) == 0
+        # a crashed churn config re-emits its rows with unit "error":
+        # flagged errored, never silently un-gated
+        ch_err = os.path.join(d, "churn_err.json")
+        _write(ch_err, {"inproc_churn8_blocks_per_min": (0.0, "error"),
+                        "inproc_churn8_join_caughtup_s": (8.0, "s")})
+        assert main([ch_base, ch_err]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ch_base), load_bench(ch_err), {})}
+        assert rows["inproc_churn8_blocks_per_min"]["status"] == "errored"
+        # the scaling breakdown stays informational (never gated)
+        assert gate_direction("inproc_churn_gossip_scaling_breakdown",
+                              "ratio") is None
         # the driver's record format ({"tail": jsonl}) parses identically
         drv = os.path.join(d, "driver.json")
         with open(drv, "w") as f:

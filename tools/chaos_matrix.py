@@ -47,6 +47,25 @@ Adversarial (content-corruption) cells — the Byzantine chaos suite:
 * combo.maverick_corrupt   — double-prevoting validator AND corrupt links
   at once; honest nodes agree (the slow combo cell)
 
+Churn cells — membership change as the fault (tools/churn.py rig):
+
+* churn.flap        — one node leaves and re-joins 3 times (fresh stores:
+  every re-entry is a full statesync restore over the wire); survivors
+  never redial the departed id, every rejoin reaches caught-up, hashes
+  stay identical
+* churn.rotate      — the full churn schedule at N=8 under open-loop load:
+  one statesync join + one clean leave per interval, the validator set
+  rotating via kvstore val: txs across app-driven prune boundaries;
+  survivor app-hash agreement, every retained height's validator set
+  resolves, AddrBook/peerscore state bounded
+* churn.partition32 — the partition cell re-run at scale: a 32-node SPARSE
+  net (4 validators + 28 fulls, ring+chords degree 4) has 8 nodes
+  blackholed, the majority keeps committing, heal reconverges everyone to
+  identical hashes
+* churn.corrupt32   — the corruption cell re-run at scale: the 32-node
+  sparse net survives capped bit flips on in-flight payloads (receivers
+  drop corrupting links, the redial loop re-heals), hashes identical
+
     python tools/chaos_matrix.py                     # full matrix
     python tools/chaos_matrix.py --quick             # skip the net cells
     python tools/chaos_matrix.py --sites statesync.lying_chunk --seeds 1,2
@@ -87,6 +106,11 @@ SITES = {
     "statesync.lying_snapshot": False,
     "blocksync.bad_block": True,
     "combo.maverick_corrupt": True,
+    # churn cells (membership change as the fault; tools/churn.py rig)
+    "churn.flap": True,
+    "churn.rotate": True,
+    "churn.partition32": True,
+    "churn.corrupt32": True,
 }
 
 
@@ -799,6 +823,108 @@ def cell_blocksync_bad_block(seed: int) -> None:
     assert strikes > 0, "victim never struck a lying provider"
 
 
+def _churn_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import churn
+
+    return churn
+
+
+def cell_churn_flap(seed: int) -> None:
+    """A flapping node: 3 leave/rejoin cycles, every rejoin a full
+    statesync restore; survivors never redial the departed id, hashes
+    identical (all asserted inside run_flap)."""
+    churn = _churn_mod()
+
+    report = churn.run_flap(cycles=3, seed=seed)
+    assert len(report["rejoin_caughtup_s"]) == 3, report
+    assert all(s < 60 for s in report["rejoin_caughtup_s"]), report
+
+
+def cell_churn_rotate(seed: int) -> None:
+    """The full N=8 churn schedule: joins + leaves + validator rotation
+    across prune boundaries under open-loop load. run_churn asserts
+    liveness, survivor app-hash agreement, prune-floor resolution, and
+    bounded book/scoreboard state; the cell checks the schedule shape."""
+    churn = _churn_mod()
+
+    report = churn.run_churn(n_nodes=8, intervals=2, seed=seed)
+    assert report["rotations"] == 2, report
+    assert len(report["join_caughtup_s"]) == 2, report
+    actions = [a for a, _ in report["executed"]]
+    assert actions.count("leave") == 2 and actions.count("join") == 2
+
+
+def _net32(seed: int, drive):
+    """Shared 32-node sparse-fleet driver: build, run `drive(net, nodes)`,
+    assert all 32 agree on a common block hash, tear down."""
+    import asyncio
+
+    churn = _churn_mod()
+
+    async def run():
+        net, nodes, _pvs, _genesis = await churn.build_fleet(
+            32, topology="sparse", degree=4, seed=seed)
+        try:
+            await churn._wait_heights(list(nodes.values()), 3, timeout=240)
+            await drive(net, nodes, churn)
+        finally:
+            for nd in nodes.values():
+                try:
+                    await nd.stop()
+                except Exception:
+                    pass
+        common = min(nd.height for nd in nodes.values()) - 1
+        hashes = {nd.block_store.load_block_meta(common).header.app_hash
+                  for nd in nodes.values()}
+        assert len(hashes) == 1, "divergent hashes across the 32-node net"
+
+    asyncio.run(run())
+
+
+def cell_churn_partition32(seed: int) -> None:
+    """Partition at scale: 8 of 32 sparse-topology nodes blackholed; the
+    majority keeps committing, heal reconverges everyone."""
+    async def drive(net, nodes, churn):
+        minority = {f"full{i}" for i in range(20, 28)}
+        net.partition(set(nodes) - minority, minority)
+        majority = [nd for n, nd in nodes.items() if n not in minority]
+        h0 = max(nd.height for nd in majority)
+        await churn._wait_heights(majority, h0 + 2, timeout=180)
+        net.heal()
+        h1 = max(nd.height for nd in majority)
+        await churn._wait_heights(list(nodes.values()), h1 + 1, timeout=240)
+
+    _net32(seed, drive)
+
+
+def cell_churn_corrupt32(seed: int) -> None:
+    """Content corruption at scale: capped bit flips on the 32-node sparse
+    net's in-flight payloads; receivers drop corrupting links, the redial
+    loop re-heals, commits continue."""
+    import asyncio
+
+    from tendermint_tpu.libs.faults import faults
+
+    cap = 20
+
+    async def drive(net, nodes, churn):
+        rewire_task = asyncio.create_task(churn.rewire_loop(net))
+        try:
+            faults.configure(f"net.corrupt@0.02*{cap}", seed=seed)
+            h0 = max(nd.height for nd in nodes.values())
+            await churn._wait_heights(list(nodes.values()), h0 + 3,
+                                      timeout=300)
+            assert faults.fires("net.corrupt") > 0, "site never fired"
+        finally:
+            # disarm on EVERY exit — 32 nodes tearing down under live bit
+            # flips would bury the real failure in link-drop noise
+            faults.reset()
+            rewire_task.cancel()
+
+    _net32(seed, drive)
+
+
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
     "device.lane": cell_device_lane,
@@ -813,6 +939,10 @@ CELLS = {
     "statesync.lying_snapshot": cell_statesync_lying_snapshot,
     "blocksync.bad_block": cell_blocksync_bad_block,
     "combo.maverick_corrupt": cell_combo_maverick_corrupt,
+    "churn.flap": cell_churn_flap,
+    "churn.rotate": cell_churn_rotate,
+    "churn.partition32": cell_churn_partition32,
+    "churn.corrupt32": cell_churn_corrupt32,
 }
 assert set(CELLS) == set(SITES)
 
@@ -878,6 +1008,9 @@ def self_test() -> None:
     faults.reset()
     cell_statesync_lying_snapshot(seed=1)
     faults.reset()
+    # churn plumbing: the plan the churn cells execute is deterministic
+    churn = _churn_mod()
+    assert churn.plan_churn(3, 2, 8) == churn.plan_churn(3, 2, 8)
     print("chaos_matrix self-test OK")
 
 

@@ -99,6 +99,11 @@ class FleetScraper:
         """Safe while the loop runs (late-joining nodes)."""
         self.endpoints[name] = url
 
+    def remove_endpoint(self, name: str) -> None:
+        """Safe while the loop runs (churned-out nodes): a scheduled leave
+        must stop counting as a scrape error against the fleet."""
+        self.endpoints.pop(name, None)
+
     def sweep(self) -> int:
         """Scrape every endpoint once, concurrently; returns how many
         answered. Concurrency matters at fleet scale: serially, a few
