@@ -1020,6 +1020,44 @@ def bench_churn():
               error=f"{type(e).__name__}: {e}")
 
 
+def bench_crash():
+    """Config crash: crash recovery, measured (tools/crashmatrix.py in-proc
+    rig — no subprocess fleet, so it runs in slim containers).
+
+    Gated row, from a seeded 4-validator run where the persistent victim
+    is SIGKILL-equivalently killed at two representative durability
+    boundaries (post-WAL-fsync and mid-window-flush) and supervisor-
+    restarted from its home dir (WAL repair-on-open + handshake replay +
+    WAL catchup replay + FilePV reload + consensus catchup):
+
+    * inproc_crash4_kill_caughtup_s — WORST kill→caught-up seconds (lower
+      better): arm boundary → victim dies at it → bounded backoff →
+      rebuild → height >= the net's tip. The recovery-time budget the
+      ROADMAP's real-fleet milestones inherit.
+
+    The full boundary matrix (10 boundaries, double-sign/evidence/
+    mempool-WAL invariants, --verify-determinism) runs as the crashmatrix
+    tool + the slow test tier; the bench keeps the fast, gateable core."""
+    cm = _tools_mod("crashmatrix")
+
+    try:
+        rep = cm.run_matrix(seed=1, boundaries=["wal.after_fsync",
+                                                "db.mid_window_flush"])
+        per = {k["boundary"]: k["kill_to_caughtup_s"] for k in rep["kills"]}
+        _emit("inproc_crash4_kill_caughtup_s",
+              max(per.values()), "s", 0.0, per_boundary=per,
+              restarts=sum(k["restarts"] for k in rep["kills"]),
+              wal_repaired=[k["boundary"] for k in rep["kills"]
+                            if k.get("wal_repaired")],
+              mempool_wal_idempotent=rep["mempool_wal_idempotent"],
+              boundaries_killed=rep["boundaries_killed"])
+    except Exception as e:
+        # the crashed-config unit convention: the gated row must read
+        # "errored", never silently vanish
+        _emit("inproc_crash4_kill_caughtup_s", 0.0, "error", 0.0,
+              error=f"{type(e).__name__}: {e}")
+
+
 def bench_verify_commit_10k():
     """FLAGSHIP (north star): VerifyCommit at 10,240 validators — the scale
     BASELINE.json names (≥15x target vs the host scalar loop, reference
@@ -1236,6 +1274,7 @@ CONFIGS = {
     "ingest": bench_ingest,
     "multichip": bench_multichip_scale,
     "churn": bench_churn,
+    "crash": bench_crash,
     "10k": bench_verify_commit_10k,
 }
 
@@ -1281,7 +1320,7 @@ if __name__ == "__main__":
             # flagship last: the driver records the final line. The remote
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
-            for key in ("2", "3", "4", "ingest", "churn", "5", "1",
+            for key in ("2", "3", "4", "ingest", "churn", "crash", "5", "1",
                         "multichip", "10k"):
                 for attempt in (1, 2):
                     try:

@@ -373,7 +373,9 @@ def cmd_debug(args) -> int:
     try:
         from .consensus.wal import WAL
 
-        wal = WAL(cfg.wal_file())
+        # repair=False: the node may be live and holding the file open for
+        # append — a read-only observer must never truncate its tail
+        wal = WAL(cfg.wal_file(), repair=False)
         msgs = list(wal.iter_messages())[-200:]
         with open(os.path.join(out, "wal_tail.jsonl"), "w") as f:
             for m in msgs:

@@ -34,8 +34,10 @@ logger = logging.getLogger("tmtpu.replay")
 
 # --- WAL catch-up (replay.go:38-163) ---------------------------------------
 
-def catchup_replay(cs: ConsensusState, height: int) -> None:
-    """Replay WAL messages for `height` into the paused state machine."""
+def catchup_replay(cs: ConsensusState, height: int) -> int:
+    """Replay WAL messages for `height` into the paused state machine;
+    returns the number of records replayed (the recovery-plane metric
+    wal_records_replayed)."""
     cs._replay_mode = True
     # replayed marks would be microseconds apart at replay time — not a
     # consensus-stage decomposition; the first live mark reopens the record
@@ -48,6 +50,7 @@ def catchup_replay(cs: ConsensusState, height: int) -> None:
         msgs = cs.wal.messages_after_end_height(height - 1)
         for m in msgs:
             _replay_message(cs, m)
+        return len(msgs)
     finally:
         cs._replay_mode = False
         cs.timeline.enabled = True

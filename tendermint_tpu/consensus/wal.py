@@ -30,6 +30,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
+from ..libs.fail import KilledAtFailPoint, fail_point
 from ..libs.faults import faults
 from ..libs.trace import tracer
 from ..types.part_set import Part
@@ -119,10 +120,24 @@ class WAL:
     #: Env override TMTPU_FSYNC_ERROR_POLICY for subprocess nets.
     fsync_error_policy = os.environ.get("TMTPU_FSYNC_ERROR_POLICY", "exit")
 
-    def __init__(self, path: str, head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT):
+    #: repair-on-open accounting (crash-recovery plane): how many torn
+    #: tails this instance truncated at open, and how many bytes went
+    repairs = 0
+    repaired_bytes = 0
+
+    def __init__(self, path: str, head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+                 repair: bool = True):
         self.path = path
         self._head_size_limit = head_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # a crash can leave a torn/garbage tail; appending after it would
+        # strand every new record behind undecodable bytes (CRC-bounded
+        # replay stops at the first bad frame), so repair BEFORE opening
+        # for append. repair=False for read-only observers (cmd debug) —
+        # truncating a file a LIVE node holds open for append would corrupt
+        # it under the owner's feet.
+        self.repaired_bytes = self._repair_tail(path) if repair else 0
+        self.repairs = 1 if self.repaired_bytes else 0
         self._f = open(path, "ab")
         self._records_since_sync = 0
         # fresh WAL: write #ENDHEIGHT 0 so height-1 catchup replay has its
@@ -130,18 +145,64 @@ class WAL:
         if self._f.tell() == 0 and not os.path.exists(f"{path}.0"):
             self.write_sync("end_height", {"height": 0})
 
+    @staticmethod
+    def _decodable_prefix_len(raw: bytes) -> int:
+        """Byte length of the longest valid-record prefix of `raw` (same
+        validity rule as iter_messages: framing + CRC + JSON envelope)."""
+        pos = 0
+        while pos + 8 <= len(raw):
+            crc, ln = struct.unpack_from(">II", raw, pos)
+            if ln > MAX_MSG_SIZE_BYTES or pos + 8 + ln > len(raw):
+                break
+            payload = raw[pos + 8:pos + 8 + ln]
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                break
+            try:
+                json.loads(payload.decode())
+            except (ValueError, UnicodeDecodeError):
+                break
+            pos += 8 + ln
+        return pos
+
+    def _repair_tail(self, path: str) -> int:
+        """Truncate any undecodable suffix of the head file so appended
+        records stay replayable; returns bytes removed (0 = clean)."""
+        if not os.path.exists(path):
+            return 0
+        with open(path, "rb") as f:
+            raw = f.read()
+        good = self._decodable_prefix_len(raw)
+        if good == len(raw):
+            return 0
+        torn = len(raw) - good
+        logger.warning(
+            "WAL %s: torn tail repaired at open — truncated %d undecodable "
+            "byte(s) after %d good byte(s) (crash mid-append; records past "
+            "the tear were never durable)", path, torn, good)
+        os.truncate(path, good)
+        return torn
+
     # -- writing -----------------------------------------------------------
 
     def _write_record(self, payload: bytes, sync: bool) -> None:
         if len(payload) > MAX_MSG_SIZE_BYTES:
             raise ValueError(f"msg is too big: {len(payload)} bytes, max: {MAX_MSG_SIZE_BYTES}")
         crc = zlib.crc32(payload) & 0xFFFFFFFF
-        self._f.write(struct.pack(">II", crc, len(payload)) + payload)
+        frame = struct.pack(">II", crc, len(payload)) + payload
+        # torn-write seam at the byte-emit point: a fired site emits a
+        # strictly partial frame (seeded prefix + optional garbage), the
+        # on-disk shape a crash mid-append leaves — repair-on-open and
+        # CRC-bounded replay are exercised against real partial data
+        self._f.write(faults.tear("wal.torn_write", frame))
         self._records_since_sync += 1
         if self._group_depth:
             # group commit: the batch's single flush/fsync happens at the
             # group() exit; record bytes are already in the file buffer in
             # write order, so replay framing is identical either way
+            if self._group_records:
+                # >=1 record of this batch appended, flush still pending —
+                # the mid-group-commit durability boundary
+                fail_point("wal.mid_group_commit")
             self._group_records += 1
             self._group_sync = self._group_sync or sync
             return
@@ -152,6 +213,10 @@ class WAL:
 
     def _fsync(self) -> None:
         n = self._records_since_sync
+        # pre/post-fsync durability boundaries (crashmatrix): before, the
+        # records are appended+flushed but their durability is unclaimed;
+        # after, they are durable and nothing has acted on them yet
+        fail_point("wal.before_fsync")
         with tracer.span("wal_fsync", n_records=n):
             t0 = time.perf_counter()
             try:
@@ -160,6 +225,7 @@ class WAL:
             except OSError as e:
                 self._on_fsync_error(e)
             dt = time.perf_counter() - t0
+        fail_point("wal.after_fsync")
         self._last_sync_t = time.monotonic()
         self._records_since_sync = 0
         m = self.metrics
@@ -194,7 +260,10 @@ class WAL:
         groups collapse into the outermost. The batch is committed even
         when the body raises: the records are already appended, and a torn
         tail is reconciled by CRC-bounded replay exactly like a torn single
-        record."""
+        record. Exception: a simulated process death (KilledAtFailPoint —
+        the crashmatrix in-proc kill) commits NOTHING on the way out — a
+        dead process flushes no batch, and committing here would make the
+        mid-group-commit durability boundary vacuously durable."""
         if self._group_depth:
             yield self
             return
@@ -203,14 +272,18 @@ class WAL:
         self._group_sync = False
         try:
             yield self
-        finally:
+        except KilledAtFailPoint:
             self._group_depth = 0
-            if self._group_records:
-                self._f.flush()
-                if self._group_sync or (time.monotonic() - self._last_sync_t
-                                        >= self.sync_deadline_s):
-                    self._fsync()
-                self._maybe_rotate()
+            raise
+        finally:
+            if self._group_depth:
+                self._group_depth = 0
+                if self._group_records:
+                    self._f.flush()
+                    if self._group_sync or (time.monotonic() - self._last_sync_t
+                                            >= self.sync_deadline_s):
+                        self._fsync()
+                    self._maybe_rotate()
 
     def _maybe_rotate(self) -> None:
         if self._f.tell() > self._head_size_limit:

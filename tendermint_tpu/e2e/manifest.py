@@ -27,6 +27,14 @@ TOML shape:
                                 # discovery entry everyone else learns
                                 # peers from (PEX), not a persistent peer
     perturb = ["kill"]          # kill | pause | restart | disconnect
+    fail_point = "wal.after_fsync"  # die ONCE at this durability boundary
+                                # (libs/fail.py KNOWN_FAIL_POINTS; needs
+                                # restart_policy = "on-failure")
+    restart_policy = "never"    # never | on-failure (supervised relaunch
+                                # with bounded exponential backoff)
+    max_restarts = 3            # consecutive fast crashes before give-up
+                                # (crash-loop debugdump bundle written)
+    backoff_s = 1.0             # base backoff; doubles per consecutive crash
     [node.validator0.misbehaviors]
     3 = "double-prevote"        # height -> misbehavior (maverick hooks)
 
@@ -73,6 +81,18 @@ class NodeManifest:
     # faults = "wal.fsync*1+3" crashes the node at its 4th fsync
     faults: str = ""
     faults_seed: int = 0
+    # crash plane: kill the node the first time it reaches this named
+    # durability boundary (libs/fail.py KNOWN_FAIL_POINTS; exported as
+    # TMTPU_FAIL_POINT). ONE-SHOT: a supervised relaunch drops the arming,
+    # so the node dies at the boundary exactly once and then recovers.
+    fail_point: str = ""
+    # restart supervision (libs/supervisor.py): "never" keeps today's
+    # dead-stays-dead behavior; "on-failure" relaunches non-clean exits
+    # with bounded exponential backoff until max_restarts consecutive
+    # fast crashes, then gives up with a crash-loop debugdump bundle
+    restart_policy: str = "never"
+    max_restarts: int = 3
+    backoff_s: float = 1.0
 
     def validate(self) -> None:
         if self.mode not in ("validator", "full"):
@@ -99,6 +119,28 @@ class NodeManifest:
                 raise ValueError(
                     f"{self.name}: unknown fault site(s) {sorted(unknown)}; "
                     f"known: {sorted(KNOWN_SITES)}")
+        if self.fail_point:
+            from ..libs.fail import KNOWN_FAIL_POINTS
+
+            if self.fail_point not in KNOWN_FAIL_POINTS:
+                # a typo'd boundary never fires and the crash cell passes
+                # vacuously — reject it where the operator can see it
+                raise ValueError(
+                    f"{self.name}: unknown fail point {self.fail_point!r}; "
+                    f"known: {sorted(KNOWN_FAIL_POINTS)}")
+            if self.restart_policy == "never":
+                raise ValueError(
+                    f"{self.name}: fail_point kills the node at a "
+                    f"durability boundary — it needs restart_policy = "
+                    f'"on-failure" to come back (or the run just loses it)')
+        from ..libs.supervisor import RestartPolicy
+
+        try:
+            RestartPolicy(policy=self.restart_policy,
+                          max_restarts=self.max_restarts,
+                          backoff_s=self.backoff_s).validate()
+        except ValueError as e:
+            raise ValueError(f"{self.name}: {e}") from e
         if self.state_sync and self.start_at == 0:
             raise ValueError(
                 f"{self.name}: state_sync nodes must join later (start_at > 0)")
@@ -155,6 +197,10 @@ class Manifest:
                               for h, m in nd.get("misbehaviors", {}).items()},
                 faults=nd.get("faults", ""),
                 faults_seed=int(nd.get("faults_seed", 0)),
+                fail_point=nd.get("fail_point", ""),
+                restart_policy=nd.get("restart_policy", "never"),
+                max_restarts=int(nd.get("max_restarts", 3)),
+                backoff_s=float(nd.get("backoff_s", 1.0)),
             ))
         m = cls(
             chain_id=doc.get("chain_id", "e2e-net"),

@@ -27,6 +27,8 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..config import CONFIG_DIR, DATA_DIR, Config
+from ..libs.supervisor import (RestartSupervisor, policy_from_manifest,
+                               write_crashloop_bundle)
 from .manifest import Manifest, NodeManifest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -63,6 +65,16 @@ class Runner:
         self.node_ids: Dict[str, str] = {}
         self.loaded_txs: List[bytes] = []
         self.departed: set = set()    # clean stop_at leaves (not failures)
+        #: crash-recovery plane: one supervisor per restart_policy !=
+        #: "never" node; poll_restarts() consults them whenever a wait
+        #: loop notices a dead process
+        self.supervisors: Dict[str, RestartSupervisor] = {
+            nm.name: RestartSupervisor(policy_from_manifest(nm), nm.name)
+            for nm in manifest.nodes if nm.restart_policy != "never"}
+        self.crashloop_bundles: Dict[str, str] = {}
+        #: nodes launched at least once — a fail_point arms ONLY the first
+        #: launch, whoever relaunches (supervisor, perturbation, joiner)
+        self._launched: set = set()
         #: name -> join-to-caught-up seconds for late joiners (the churn
         #: metric: launch → height >= the net's height at launch time)
         self.join_stats: Dict[str, float] = {}
@@ -179,7 +191,8 @@ class Runner:
                 for a, b in edges if nm.name in (a, b)}
         return [o for o in others if o.name in mine]
 
-    def _env(self, nm: NodeManifest) -> dict:
+    def _env(self, nm: NodeManifest, first_launch: bool = True,
+             restart_reason: str = "") -> dict:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -195,6 +208,14 @@ class Runner:
             # import, so the subprocess starts with the sites live)
             env["TMTPU_FAULTS"] = nm.faults
             env["TMTPU_FAULTS_SEED"] = str(nm.faults_seed)
+        if nm.fail_point and first_launch:
+            # one-shot: the FIRST process dies at the boundary; supervised
+            # relaunches drop the arming so recovery can be observed
+            env["TMTPU_FAIL_POINT"] = nm.fail_point
+        if restart_reason:
+            # the restarted node exports restarts_total{reason} on its own
+            # /metrics (libs/metrics.py RecoveryMetrics, wired in node.py)
+            env["TMTPU_RESTART_REASON"] = restart_reason
         # stall watchdog: an e2e node that silently stops committing should
         # leave a debugdump bundle behind, not just a hung run
         env.setdefault("TMTPU_STALL_WATCHDOG_S", "60")
@@ -205,9 +226,17 @@ class Runner:
         env["TMTPU_FLEET_JSON"] = os.path.join(self.root, "fleet.json")
         return env
 
-    def _launch(self, nm: NodeManifest) -> None:
+    def _launch(self, nm: NodeManifest, restart_reason: str = "") -> None:
         cfg = self.configs[nm.name]
-        env = self._env(nm)
+        # the one-shot fail_point arming is derived HERE, not passed by
+        # callers: perturbation relaunches and supervised restarts alike
+        # must drop it or the node dies at the boundary forever
+        env = self._env(nm, first_launch=nm.name not in self._launched,
+                        restart_reason=restart_reason)
+        self._launched.add(nm.name)
+        sup = self.supervisors.get(nm.name)
+        if sup is not None:
+            sup.on_launch()
         if nm.privval == "tcp" and nm.name not in self.signers:
             pvp = cfg.base.priv_validator_laddr.rpartition(":")[-1]
             self.signers[nm.name] = subprocess.Popen(
@@ -256,6 +285,7 @@ class Runner:
         for name, (t0, target) in list(self._join_marks.items()):
             deadline = time.time() + timeout
             while time.time() < deadline:
+                self.poll_restarts()
                 if self.height(name) >= target:
                     self.join_stats[name] = round(time.time() - t0, 3)
                     break
@@ -288,6 +318,47 @@ class Runner:
             self.departed.add(nm.name)
             if self._fleet is not None:
                 self._fleet.remove_endpoint(nm.name)
+
+    def poll_restarts(self) -> None:
+        """Crash-recovery supervision: relaunch any supervised node whose
+        process died (non-clean exit, not a scheduled departure) after its
+        policy's backoff; on crash-loop give-up, write the debugdump
+        bundle and leave the node down (invariant checks will then fail
+        loudly — a crash loop IS a failed run). Called from every wait
+        loop so supervision needs no extra thread."""
+        by_name = {nm.name: nm for nm in self.m.nodes}
+        for name, sup in self.supervisors.items():
+            proc = self.procs.get(name)
+            if proc is None or name in self.departed:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue  # still running
+            delay = sup.on_exit(rc)
+            if delay is None:
+                if sup.gave_up and name not in self.crashloop_bundles:
+                    self.crashloop_bundles[name] = write_crashloop_bundle(
+                        self.root, sup,
+                        extras={"manifest_node": name,
+                                "home": self.configs[name].root_dir},
+                        log_path=os.path.join(self.root, f"{name}.log"))
+                    self._note(f"supervisor gave up on {name} "
+                               f"(crash loop); bundle at "
+                               f"{self.crashloop_bundles[name]}")
+                # staying down (clean exit or give-up): drop the carcass so
+                # the next poll doesn't re-record the same exit forever
+                self.procs.pop(name, None)
+                continue
+            self._note(f"supervisor restarting {name} (rc={rc}, "
+                       f"restart #{sup.restarts}) after {delay:.2f}s")
+            time.sleep(delay)
+            self._launch(by_name[name],
+                         restart_reason=sup.history[-1].reason)
+
+    def _note(self, msg: str) -> None:
+        if self._log:
+            self._log.write(msg + "\n")
+            self._log.flush()
 
     def _point_state_sync(self, nm: NodeManifest) -> None:
         """Fill rpc_servers + trust root from the live net just before the
@@ -468,11 +539,13 @@ class Runner:
         net."""
         deadline = time.time() + timeout
         while time.time() < deadline:
+            self.poll_restarts()
             down = [n for n in self.procs if self.height(n) < 0]
             if not down:
                 return
-            for n in down:  # a crashed process will never answer
-                if self.procs[n].poll() is not None:
+            for n in down:  # an unsupervised crashed process never answers
+                if (self.procs[n].poll() is not None
+                        and n not in self.supervisors):
                     raise E2EError(
                         f"node {n} exited rc={self.procs[n].returncode}")
             time.sleep(1.0)
@@ -483,6 +556,7 @@ class Runner:
         names = nodes or list(self.procs)
         deadline = time.time() + timeout
         while time.time() < deadline:
+            self.poll_restarts()
             if any(self.height(n) >= h for n in names):
                 return
             time.sleep(1.0)

@@ -14,11 +14,19 @@ import sqlite3
 import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from .fail import fail_point
 from .faults import faults
 
 
 def _injected_db_fault(site: str) -> OSError:
     return OSError(errno.EIO, f"injected fault at {site}")
+
+
+def _torn_write_cut(n_sets: int) -> "int | None":
+    """Evaluate the ``db.torn_write`` site against a batch of n_sets
+    records: a fired site returns the seeded prefix length to apply before
+    failing (the batch-level analog of a byte-level torn write)."""
+    return faults.tear_index("db.torn_write", n_sets)
 
 
 class DB:
@@ -174,6 +182,15 @@ class MemDB(DB):
         # chaos site shared with SQLiteDB: a fired fault applies NOTHING
         # (all-or-nothing, like the sqlite transaction)
         faults.inject("db.write_batch", _injected_db_fault)
+        # torn-write site: MemDB has no transaction, so a torn batch leaves
+        # a PARTIAL prefix applied — the retry (BufferedDB keeps the staged
+        # window on error) must land the whole window via idempotent upserts
+        cut = _torn_write_cut(len(sets))
+        if cut is not None:
+            with self._lock:
+                for k, v in list(sets)[:cut]:
+                    self.set(k, v)
+            raise _injected_db_fault("db.torn_write")
         with self._lock:
             for k, v in sets:
                 self.set(k, v)
@@ -232,12 +249,26 @@ class SQLiteDB(DB):
         # the transaction so a fired fault applies nothing (the sqlite
         # transaction itself already guarantees all-or-nothing)
         faults.inject("db.write_batch", _injected_db_fault)
+        # torn-write site: a seeded prefix is staged IN the transaction,
+        # then the write dies — sqlite rolls the partial work back, so the
+        # base stays untouched and the caller's retry lands the whole window
+        cut = _torn_write_cut(len(sets))
         with self._lock:
+            if cut is not None:
+                self._conn.executemany(
+                    "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                    list(sets)[:cut])
+                self._conn.rollback()
+                raise _injected_db_fault("db.torn_write")
             self._conn.executemany(
                 "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
                 sets)
             if deletes:
                 self._conn.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
+            # mid-window-flush durability boundary (crashmatrix): the whole
+            # batch is staged in the open transaction, nothing committed —
+            # a kill here must read back as all-or-nothing on reopen
+            fail_point("db.mid_window_flush")
             self._conn.commit()
 
     def close(self) -> None:

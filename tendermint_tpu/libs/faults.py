@@ -58,6 +58,22 @@ verification path runs against the tampered bytes):
 
 All four are injected at the SERVER so the syncing/receiving node — the
 victim — exercises its production verification + peer-banning paths.
+
+Torn-write sites (the crash plane — ``tear`` truncates a payload at a
+seeded prefix and may append a seeded garbage suffix, modeling a write
+the process died in the middle of, so the victim's CRC-bounded replay,
+repair-on-open, atomic-rename, and WAL-replay paths run against REAL
+partial data instead of clean exceptions):
+
+* ``wal.torn_write``    — consensus WAL record emit, group commits
+                          included (consensus/wal.py)
+* ``db.torn_write``     — KV write batches: a seeded PREFIX of the batch
+                          lands before the failure (libs/db.py — the
+                          batch-level analog of a byte tear)
+* ``privval.torn_state`` — last-sign-state atomic write
+                          (privval/file_pv.py)
+* ``mempool.wal_torn``  — MempoolWAL tx-line emit
+                          (mempool/clist_mempool.py)
 """
 
 from __future__ import annotations
@@ -87,6 +103,11 @@ KNOWN_SITES = frozenset({
     "statesync.lying_snapshot",
     "statesync.lying_chunk",
     "blocksync.bad_block",
+    # torn-write (crash) sites — consulted via tear()/tear_index()
+    "wal.torn_write",
+    "db.torn_write",
+    "privval.torn_state",
+    "mempool.wal_torn",
 })
 
 #: site-name prefixes that are known as a FAMILY: the multi-device
@@ -296,6 +317,47 @@ class FaultPlane:
         out = bytearray(data)
         out[pos] ^= bit
         return bytes(out)
+
+    def tear(self, site: str, data: bytes) -> bytes:
+        """Torn-write seam: when `site` fires, return `data` truncated at a
+        seeded prefix (0 <= cut < len — always strictly partial) with, on a
+        seeded coin flip, a short garbage suffix appended (the disk sector
+        half-written at crash time). `data` unchanged otherwise. Both draws
+        come from the site's own stream, so the i-th tear of a site is the
+        same tear every run — a torn-tail repro replays from its seed.
+        Empty payloads pass through (nothing to tear)."""
+        if not self._sites or not data:
+            return data
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None or not st.evaluate():
+                return data
+            cut = st.rng.randrange(len(data))
+            garbage = b""
+            if st.rng.random() < 0.5:
+                garbage = st.rng.randbytes(st.rng.randrange(1, 9))
+        m = metrics
+        if m is not None:
+            m.faults_injected_total.labels(site).inc()
+        return data[:cut] + garbage
+
+    def tear_index(self, site: str, n: int) -> Optional[int]:
+        """Batch-level tear: when `site` fires, a seeded cut index in
+        [0, n) — the caller applies only items[:cut] before failing, the
+        multi-record analog of a byte-level torn write (used by the KV
+        write-batch seam, where the unit of emission is a record, not a
+        byte). None when the site is quiet."""
+        if not self._sites or n <= 0:
+            return None
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None or not st.evaluate():
+                return None
+            cut = st.rng.randrange(n)
+        m = metrics
+        if m is not None:
+            m.faults_injected_total.labels(site).inc()
+        return cut
 
     # -- introspection (tests / tools) -------------------------------------
 

@@ -1,0 +1,187 @@
+"""Restart supervision with bounded exponential backoff — the crash-recovery
+plane's policy engine.
+
+A node that dies at a durability boundary must come BACK (and its recovery
+must be measurable), but a node that dies instantly every time it comes
+back must NOT be restarted forever: that is a crash loop, and the right
+move is to stop, keep the evidence, and page an operator. This module is
+the shared decision core for both harnesses:
+
+* the e2e ``Runner`` supervises SUBPROCESS nodes whose manifest says
+  ``restart_policy = "on-failure"`` (``e2e/runner.py poll_restarts``);
+* the in-proc crash matrix (``tools/crashmatrix.py``) supervises rig nodes
+  it kills at fail points and rebuilds from their home dirs.
+
+Policy semantics (manifest keys map 1:1):
+
+* ``policy``       — ``"never"`` (default: a dead node stays dead, today's
+                     behavior) or ``"on-failure"`` (restart on any
+                     non-clean exit).
+* ``max_restarts`` — consecutive-fast-crash budget: after this many
+                     crashes WITHOUT an intervening healthy run the
+                     supervisor gives up (``gave_up``) and the harness
+                     writes a crash-loop debugdump bundle.
+* ``backoff_s``    — base delay; the i-th consecutive crash waits
+                     ``backoff_s * 2**i`` capped at ``backoff_max_s``.
+* ``healthy_uptime_s`` — an exit after at least this much uptime resets
+                     the consecutive counter: an occasional crasher earns
+                     its budget back, an instant crasher burns through it.
+
+All decisions are pure functions of (policy, exit history, clock) — the
+supervisor takes an injectable ``time_fn`` so unit tests and the seeded
+crash matrix stay deterministic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+RESTART_POLICIES = ("never", "on-failure")
+
+
+@dataclass
+class RestartPolicy:
+    policy: str = "never"
+    max_restarts: int = 3
+    backoff_s: float = 0.5
+    backoff_max_s: float = 30.0
+    healthy_uptime_s: float = 30.0
+
+    def validate(self) -> None:
+        if self.policy not in RESTART_POLICIES:
+            raise ValueError(f"unknown restart policy {self.policy!r}; "
+                             f"known: {RESTART_POLICIES}")
+        if self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        if self.backoff_s <= 0 or self.backoff_max_s < self.backoff_s:
+            raise ValueError("need 0 < backoff_s <= backoff_max_s")
+        if self.healthy_uptime_s < 0:
+            raise ValueError("healthy_uptime_s must be >= 0")
+
+    def delay(self, consecutive_crashes: int) -> float:
+        """Backoff before the restart that follows the Nth consecutive
+        crash (1-based): backoff_s * 2**(n-1), capped."""
+        n = max(1, consecutive_crashes)
+        return min(self.backoff_max_s, self.backoff_s * (2.0 ** (n - 1)))
+
+    def schedule(self) -> List[float]:
+        """The full backoff schedule a crash-looping child walks before
+        the supervisor gives up."""
+        return [self.delay(i + 1) for i in range(self.max_restarts)]
+
+
+@dataclass
+class ExitRecord:
+    at: float
+    uptime_s: float
+    exit_code: int
+    reason: str
+    action: str  # "restart" | "give-up" | "stop" | "clean"
+
+
+class RestartSupervisor:
+    """Tracks one child's launch/exit lifecycle and decides restarts.
+
+    Usage::
+
+        sup = RestartSupervisor(policy, name="validator3")
+        sup.on_launch()
+        ...child exits with rc...
+        delay = sup.on_exit(rc)     # None = do not restart
+        if delay is None and sup.gave_up: write_crashloop_bundle(...)
+    """
+
+    def __init__(self, policy: RestartPolicy, name: str = "node",
+                 time_fn: Callable[[], float] = time.monotonic):
+        policy.validate()
+        self.policy = policy
+        self.name = name
+        self._now = time_fn
+        self._launched_at: Optional[float] = None
+        self.restarts = 0            # restarts actually granted
+        self.consecutive_crashes = 0  # fast crashes since last healthy run
+        self.gave_up = False
+        self.history: List[ExitRecord] = []
+
+    def on_launch(self) -> None:
+        self._launched_at = self._now()
+
+    def on_exit(self, exit_code: int,
+                clean_exit_codes: tuple = (0,)) -> Optional[float]:
+        """Record an exit; returns the backoff seconds to wait before
+        relaunching, or None when the child must stay down (clean exit,
+        policy "never", or crash-loop give-up — check ``gave_up``)."""
+        now = self._now()
+        uptime = (now - self._launched_at) if self._launched_at is not None \
+            else 0.0
+        self._launched_at = None
+        if exit_code in clean_exit_codes:
+            self.consecutive_crashes = 0
+            self._record(now, uptime, exit_code, "clean", "clean")
+            return None
+        reason = "crash" if exit_code >= 0 else f"signal-{-exit_code}"
+        if self.policy.policy == "never":
+            self._record(now, uptime, exit_code, reason, "stop")
+            return None
+        if self.gave_up:
+            self._record(now, uptime, exit_code, reason, "give-up")
+            return None
+        if uptime >= self.policy.healthy_uptime_s:
+            # a healthy run re-earns the crash budget
+            self.consecutive_crashes = 0
+        self.consecutive_crashes += 1
+        if self.consecutive_crashes > self.policy.max_restarts:
+            self.gave_up = True
+            self._record(now, uptime, exit_code, reason, "give-up")
+            return None
+        self.restarts += 1
+        self._record(now, uptime, exit_code, reason, "restart")
+        return self.policy.delay(self.consecutive_crashes)
+
+    def _record(self, at: float, uptime: float, rc: int, reason: str,
+                action: str) -> None:
+        self.history.append(ExitRecord(at, round(uptime, 3), rc, reason,
+                                       action))
+
+    def summary(self) -> Dict:
+        return {
+            "name": self.name,
+            "policy": self.policy.policy,
+            "restarts": self.restarts,
+            "consecutive_crashes": self.consecutive_crashes,
+            "gave_up": self.gave_up,
+            "history": [vars(r) for r in self.history],
+        }
+
+
+def policy_from_manifest(nm) -> RestartPolicy:
+    """Build a policy from an e2e NodeManifest's restart keys."""
+    return RestartPolicy(policy=nm.restart_policy,
+                         max_restarts=nm.max_restarts,
+                         backoff_s=nm.backoff_s)
+
+
+def write_crashloop_bundle(out_dir: str, sup: "RestartSupervisor",
+                           extras: Optional[Dict[str, str]] = None,
+                           log_path: Optional[str] = None,
+                           log_tail_bytes: int = 65536) -> str:
+    """The give-up artifact: a JSON bundle with the full exit history plus
+    the tail of the child's log — what an operator (or a postmortem) needs
+    to see WHY the supervisor stopped trying. Returns the bundle path."""
+    os.makedirs(out_dir, exist_ok=True)
+    doc = {"crashloop": sup.summary(), "extras": extras or {}}
+    if log_path and os.path.exists(log_path):
+        try:
+            with open(log_path, "rb") as f:
+                f.seek(max(0, os.path.getsize(log_path) - log_tail_bytes))
+                doc["log_tail"] = f.read().decode(errors="replace")
+        except OSError as e:
+            doc["log_tail_error"] = str(e)
+    path = os.path.join(out_dir, f"crashloop-{sup.name}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return path

@@ -351,10 +351,50 @@ class MempoolWAL:
         import os
 
         os.makedirs(wal_dir, exist_ok=True)
-        self._f = open(os.path.join(wal_dir, "wal"), "ab")
+        path = os.path.join(wal_dir, "wal")
+        self._repair_tail(path)
+        self._f = open(path, "ab")
+
+    @staticmethod
+    def _repair_tail(path: str) -> None:
+        """Repair-on-open: truncate a partial (newline-less) tail line a
+        crash left behind. Appending after it would MERGE the torn hex
+        with the next tx's hex — often still valid hex, so replay would
+        admit a bogus tx and silently lose the first post-restart one."""
+        import os
+
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size == 0:
+            return
+        with open(path, "rb") as f:
+            f.seek(max(0, size - 1))
+            if f.read(1) == b"\n":
+                return
+            # only the tail line matters; a line is at most one tx's hex
+            # (2*max_tx_bytes+1), so a bounded tail read covers it
+            tail_len = min(size, 4 * 1024 * 1024)
+            f.seek(size - tail_len)
+            raw = f.read()
+        cut = raw.rfind(b"\n")
+        if cut < 0 and tail_len < size:
+            # torn line longer than the window (pathological): scan whole
+            with open(path, "rb") as f:
+                raw = f.read()
+            tail_len, cut = size, raw.rfind(b"\n")
+        good = 0 if cut < 0 else size - tail_len + cut + 1
+        os.truncate(path, good)
 
     def write(self, tx: bytes) -> None:
-        self._f.write(tx.hex().encode() + b"\n")
+        from ..libs.faults import faults
+
+        # torn-write seam at the byte-emit point: a fired site persists a
+        # partial line (what a crash mid-append leaves); replay skips the
+        # undecodable line and stays idempotent
+        self._f.write(faults.tear("mempool.wal_torn",
+                                  tx.hex().encode() + b"\n"))
         self._f.flush()
 
     def close(self) -> None:

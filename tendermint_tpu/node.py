@@ -73,6 +73,11 @@ class Node:
     def __init__(self, config: Config, priv_validator: Optional[PrivValidator],
                  node_key: NodeKey, genesis: GenesisDoc,
                  app: Optional[Application] = None):
+        import time as _time
+
+        # recovery clock: assembly → consensus-ready is the measurable
+        # recovery duration (stores + handshake + WAL replay + start)
+        self._boot_t0 = _time.monotonic()
         self.config = config
         self.genesis = genesis
         self.node_key = node_key
@@ -195,6 +200,21 @@ class Node:
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
         self.priv_validator = priv_validator
+        # crash-recovery guard: a FRESH sign state (height 0) next to a
+        # non-empty block store means the last-sign-state file went missing
+        # on a validator that has already been part of a chain — every
+        # signed height is re-armed for re-signing. FilePV.load already
+        # warned; with blocks present, escalate so operators can't miss it.
+        lss = getattr(priv_validator, "last_sign_state", None)
+        if (lss is not None and lss.height == 0
+                and self.block_store.height() > 0):
+            logger.warning(
+                "priv validator sign state is FRESH (height 0) but the "
+                "block store holds heights %d..%d — if this validator "
+                "signed any of them, double-sign protection has been "
+                "reset; restore %s from backup before relying on it",
+                self.block_store.base(), self.block_store.height(),
+                getattr(lss, "file_path", "") or "the state file")
         self.mempool.tx_available_callbacks.append(
             self.consensus_state.notify_txs_available)
 
@@ -283,6 +303,20 @@ class Node:
 
         set_breaker_metrics(self.metrics.crypto)
         set_fault_metrics(self.metrics.faults)
+        # crash-recovery plane: surface what this boot repaired and — when
+        # a supervisor relaunched us — why (the e2e runner exports
+        # TMTPU_RESTART_REASON on supervised relaunches so restart counts
+        # live on the restarted node's own /metrics)
+        if wal.repairs:
+            self.metrics.recovery.wal_repairs_total.inc(wal.repairs)
+            self.metrics.recovery.wal_repaired_bytes_total.inc(
+                wal.repaired_bytes)
+            logger.warning("WAL repair-on-open removed %d torn byte(s); "
+                           "recovery continues from the durable prefix",
+                           wal.repaired_bytes)
+        restart_reason = os.environ.get("TMTPU_RESTART_REASON")
+        if restart_reason:
+            self.metrics.recovery.restarts_total.labels(restart_reason).inc()
 
         # consensus stall watchdog (config.consensus.stall_watchdog_s > 0,
         # or TMTPU_STALL_WATCHDOG_S for subprocess nets — e2e runner sets
@@ -480,8 +514,9 @@ class Node:
             # protection by re-signing an already-signed proposal/vote.
             from .consensus.replay import catchup_replay
 
-            catchup_replay(self.consensus_state,
-                           self.consensus_state.rs.height)
+            replayed = catchup_replay(self.consensus_state,
+                                      self.consensus_state.rs.height)
+            self.metrics.recovery.wal_records_replayed.set(replayed)
             await self.consensus_state.start()
         # (fast-sync case: Switch.start() already started the reactor)
         if self._watchdog is not None:
@@ -489,6 +524,10 @@ class Node:
         if self.config.p2p.persistent_peers:
             peers = parse_peer_list(self.config.p2p.persistent_peers)
             self.switch.dial_peers_async(peers, persistent=True)
+        import time as _time
+
+        self.metrics.recovery.recovery_duration_seconds.set(
+            _time.monotonic() - self._boot_t0)
         logger.info("node %s started: p2p=%s rpc=%s", self.node_key.id[:8],
                     self.listen_addr, self.config.rpc.laddr or "off")
 

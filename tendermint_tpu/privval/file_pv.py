@@ -10,6 +10,7 @@ the remote-signer reconnect case.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 from dataclasses import dataclass, field
@@ -17,10 +18,14 @@ from typing import Optional, Tuple
 
 from .. import crypto
 from ..libs import protowire as pw
+from ..libs.fail import fail_point
+from ..libs.faults import faults
 from ..types.basic import SignedMsgType
 from ..types.priv_validator import PrivValidator
 from ..types.proposal import Proposal
 from ..types.vote import Vote
+
+logger = logging.getLogger("tmtpu.privval")
 
 STEP_NONE = 0
 STEP_PROPOSE = 1
@@ -38,6 +43,22 @@ def vote_to_step(v: Vote) -> int:
 
 class DoubleSignError(Exception):
     pass
+
+
+class CorruptSignStateError(Exception):
+    """The last-sign-state file exists but cannot be decoded. Fatal at
+    startup BY DESIGN: silently resetting to height 0 would let this
+    validator re-sign heights it already signed — the double-sign hazard
+    the file exists to prevent. The operator must restore the file from
+    backup (or, only if certain this key never signed, remove it)."""
+
+    def __init__(self, path: str, cause: Exception):
+        super().__init__(
+            f"priv validator state file {path!r} is corrupt ({cause}); "
+            f"refusing to start — silently resetting the sign state would "
+            f"allow double-signing. Restore {path!r} from backup, or remove "
+            f"it ONLY if this validator key has never signed.")
+        self.path = path
 
 
 @dataclass
@@ -80,35 +101,65 @@ class LastSignState:
             "height": self.height, "round": self.round, "step": self.step,
             "signature": self.signature.hex(), "signbytes": self.sign_bytes.hex(),
         }, indent=2)
-        _atomic_write(self.file_path, data)
+        _atomic_write(self.file_path, data, tear_site="privval.torn_state")
 
     @staticmethod
     def load(path: str) -> "LastSignState":
-        with open(path) as f:
-            d = json.load(f)
-        return LastSignState(
-            height=d.get("height", 0), round=d.get("round", 0),
-            step=d.get("step", STEP_NONE),
-            signature=bytes.fromhex(d.get("signature", "")),
-            sign_bytes=bytes.fromhex(d.get("signbytes", "")),
-            file_path=path,
-        )
+        """Decode the persisted sign state; a file that exists but cannot
+        be decoded raises CorruptSignStateError naming the file (never a
+        bare decode error, never a silent height-0 reset)."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            d = json.loads(raw.decode())
+            return LastSignState(
+                height=int(d.get("height", 0)), round=int(d.get("round", 0)),
+                step=int(d.get("step", STEP_NONE)),
+                signature=bytes.fromhex(d.get("signature", "")),
+                sign_bytes=bytes.fromhex(d.get("signbytes", "")),
+                file_path=path,
+            )
+        except (ValueError, UnicodeDecodeError, AttributeError, TypeError) as e:
+            raise CorruptSignStateError(path, e) from e
 
 
-def _atomic_write(path: str, data: str) -> None:
-    """(libs/tempfile atomic write)"""
+def _atomic_write(path: str, data: str, tear_site: Optional[str] = None) -> None:
+    """(libs/tempfile atomic write) — temp write + fsync + rename + DIR
+    fsync: os.replace puts the new name in the directory, but the rename
+    itself is only durable once the directory inode is synced; without it
+    a crash right after replace can resurrect the OLD file (or no file).
+    ``tear_site`` routes the payload through the torn-write fault seam at
+    the byte-emit point (a fired site persists a strictly partial file —
+    what an fsync-less crash mid-write leaves)."""
     d = os.path.dirname(path) or "."
+    payload = data.encode()
+    if tear_site is not None:
+        payload = faults.tear(tear_site, payload)
     fd, tmp = tempfile.mkstemp(dir=d)
     try:
-        with os.fdopen(fd, "w") as f:
-            f.write(data)
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(d)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def _fsync_dir(d: str) -> None:
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory opens (e.g. Windows)
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass  # some filesystems refuse dir fsync; rename is best-effort there
+    finally:
+        os.close(dfd)
 
 
 class FilePV(PrivValidator):
@@ -145,8 +196,18 @@ class FilePV(PrivValidator):
         priv = crypto.Ed25519PrivKey(bytes.fromhex(d["priv_key"]["value"]))
         pv = FilePV(priv, key_file_path, state_file_path)
         if os.path.exists(state_file_path):
+            # a corrupt file raises CorruptSignStateError — startup must
+            # fail loudly, never silently reset (the double-sign hazard)
             pv.last_sign_state = LastSignState.load(state_file_path)
         else:
+            # the key exists but its sign state doesn't: legitimate only on
+            # a brand-new validator — if this node ever signed, starting at
+            # height 0 re-arms every height for re-signing. The node layer
+            # re-checks this against the block store and escalates.
+            logger.warning(
+                "priv validator state file %s is absent; initializing sign "
+                "state at height 0 — if this validator has signed before, "
+                "restore the file instead of proceeding", state_file_path)
             pv.last_sign_state = LastSignState(file_path=state_file_path)
         return pv
 
@@ -202,6 +263,11 @@ class FilePV(PrivValidator):
 
     def _save_signed(self, height: int, round_: int, step: int,
                      sign_bytes: bytes, sig: bytes) -> None:
+        # durability boundary (crashmatrix): the signature exists but the
+        # sign state doesn't yet — a kill here must recover without the
+        # restarted validator equivocating (the unsent signature dies with
+        # the process; the state file still holds the previous HRS)
+        fail_point("privval.between_sign_and_save")
         lss = self.last_sign_state
         lss.height, lss.round, lss.step = height, round_, step
         lss.signature = sig

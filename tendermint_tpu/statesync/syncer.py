@@ -326,6 +326,13 @@ class Syncer:
                     continue
                 chunk = self.chunks.get(applied)
                 sender = self.chunks.sender(applied)
+                if applied > 0:
+                    # durability boundary (crashmatrix): >=1 chunk is in the
+                    # app, the restore incomplete — a killed joiner must
+                    # retry the restore from scratch, never trust the torso
+                    from ..libs.fail import fail_point
+
+                    fail_point("statesync.mid_chunk_apply")
                 r = self.app_snapshot.apply_snapshot_chunk(
                     abci.RequestApplySnapshotChunk(
                         index=applied, chunk=chunk, sender=sender))
@@ -432,7 +439,14 @@ class Syncer:
             try:
                 await self.request_chunk(peer_id, key.height, key.format, idx)
             except Exception:
+                # the retry MUST yield: a request that fails synchronously
+                # (every peer gone — e.g. the node was pulled from the net
+                # mid-restore) would otherwise busy-spin this loop without
+                # ever reaching an await, starving the event loop and making
+                # the surrounding sync task uncancellable (found by
+                # tools/crashmatrix.py's mid-chunk-apply kill)
                 self._discard(idx)
+                await asyncio.sleep(0.05)
                 continue
             deadline = asyncio.get_running_loop().time() + self.chunk_timeout
             while not self.chunks.has(idx):

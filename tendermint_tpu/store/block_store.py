@@ -201,6 +201,12 @@ class BlockStore:
                 deletes.append(_commit_key(h))
                 deletes.append(_seen_commit_key(h))
                 pruned += 1
+            # durability boundary (crashmatrix): the prune set is chosen but
+            # not applied — a kill here must leave either the pre-prune or
+            # post-prune store, never a half-readable base
+            from ..libs.fail import fail_point
+
+            fail_point("prune.mid_blocks")
             self._db.write_batch([], deletes)
             self._base = retain_height
             self._save_state()

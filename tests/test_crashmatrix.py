@@ -1,0 +1,83 @@
+"""tools/crashmatrix.py as a test seam: the stdlib self-test and plan
+determinism run in tier-1; the kill-at-every-durability-boundary matrix
+itself (live in-proc fleet, supervised restarts, app-hash/double-sign
+invariants) runs in the slow tier across 2 seeds with determinism
+verified — the ISSUE's acceptance gate, as a test."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "crashmatrix.py")
+
+
+def _cm():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import crashmatrix
+    finally:
+        sys.path.pop(0)
+    return crashmatrix
+
+
+def test_self_test_subprocess():
+    res = subprocess.run([sys.executable, TOOL, "--self-test"],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "self-test OK" in res.stdout
+
+
+def test_plan_determinism_and_shape():
+    cm = _cm()
+    p1, p2 = cm.plan_crashes(3), cm.plan_crashes(3)
+    assert p1 == p2
+    assert cm.plan_crashes(4) != p1
+    assert {k["boundary"] for k in p1["kills"]} == set(cm.ALL_BOUNDARIES)
+    # the joiner boundary runs last; everything else targets the victim
+    assert p1["kills"][-1]["target"] == "joiner"
+
+
+def test_fingerprint_strips_wall_clock():
+    cm = _cm()
+    rep = {"plan": cm.plan_crashes(1), "kills": [
+        {"boundary": "wal.after_fsync", "target": cm.VICTIM, "killed": True,
+         "recovered": True, "restarts": 1, "evidence": 0,
+         "double_sign_observed": False, "kill_to_caughtup_s": 1.23,
+         "backoff_s": 0.2}]}
+    fp = cm.outcome_fingerprint(rep)
+    import json
+
+    assert "kill_to_caughtup_s" not in json.dumps(fp)
+    assert fp["kills"][0]["killed"] is True
+
+
+def test_single_boundary_live():
+    """One live kill+recover cycle in tier-1: the cheapest boundary,
+    proving the whole rig (persistent victim, in-proc SIGKILL semantics,
+    supervised rebuild, invariants) end to end without the slow tier."""
+    cm = _cm()
+    rep = cm.run_matrix(seed=1, boundaries=["wal.after_fsync"])
+    assert rep["boundaries_killed"] == ["wal.after_fsync"]
+    k = rep["kills"][0]
+    assert k["killed"] and k["recovered"]
+    assert not k["double_sign_observed"] and k["evidence"] == 0
+    assert rep["mempool_wal_idempotent"] is True
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_full_matrix_two_seeds_deterministic(seed):
+    """The acceptance gate: every enumerated durability boundary, killed
+    and recovered, same-seed reruns agreeing on schedule + outcomes."""
+    cm = _cm()
+    r1 = cm.run_matrix(seed=seed)
+    assert set(r1["boundaries_killed"]) == set(cm.ALL_BOUNDARIES)
+    for k in r1["kills"]:
+        assert k["killed"] and k["recovered"], k
+        assert not k["double_sign_observed"], k
+    assert r1["mempool_wal_idempotent"] is True
+    r2 = cm.run_matrix(seed=seed)
+    assert cm.outcome_fingerprint(r1) == cm.outcome_fingerprint(r2)

@@ -50,6 +50,18 @@ def test_manifest_byzantine_evidence(tmp_path):
     _run("ci-byzantine.toml", tmp_path, 29180)
 
 
+@pytest.mark.slow
+def test_manifest_crash_recovery(tmp_path):
+    """A validator dies ONCE at a WAL durability boundary (one-shot
+    fail_point) and its supervisor relaunches it with bounded backoff —
+    the subprocess variant of tools/crashmatrix.py. The run's invariants
+    (heights, app hashes, txs everywhere) prove the recovery."""
+    r = _run("ci-crash.toml", tmp_path, 29220)
+    sup = r.supervisors["crasher"]
+    assert sup.restarts >= 1, "the fail point never killed the crasher"
+    assert not sup.gave_up, "recovery read as a crash loop"
+
+
 def test_manifest_validation():
     with pytest.raises(ValueError):
         Manifest.from_doc({"node": {}})  # no nodes

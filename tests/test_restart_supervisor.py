@@ -1,0 +1,224 @@
+"""Restart supervision (libs/supervisor.py): backoff schedule, crash-loop
+give-up, never-restart default, healthy-uptime budget reset, the crash-loop
+bundle, and the e2e manifest's restart/fail_point keys."""
+
+import json
+
+import pytest
+
+from tendermint_tpu.e2e.manifest import Manifest
+from tendermint_tpu.libs.supervisor import (RestartPolicy, RestartSupervisor,
+                                            policy_from_manifest,
+                                            write_crashloop_bundle)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _sup(clock, **kw):
+    defaults = dict(policy="on-failure", max_restarts=3, backoff_s=0.5,
+                    backoff_max_s=4.0, healthy_uptime_s=10.0)
+    defaults.update(kw)
+    return RestartSupervisor(RestartPolicy(**defaults), name="n",
+                             time_fn=clock)
+
+
+class TestPolicy:
+    def test_backoff_schedule_bounded_doubling(self):
+        p = RestartPolicy(policy="on-failure", max_restarts=5,
+                          backoff_s=0.5, backoff_max_s=3.0)
+        assert p.schedule() == [0.5, 1.0, 2.0, 3.0, 3.0]  # capped
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown restart policy"):
+            RestartPolicy(policy="always").validate()
+        with pytest.raises(ValueError, match="max_restarts"):
+            RestartPolicy(max_restarts=-1).validate()
+        with pytest.raises(ValueError, match="backoff"):
+            RestartPolicy(backoff_s=0.0).validate()
+        with pytest.raises(ValueError, match="backoff"):
+            RestartPolicy(backoff_s=2.0, backoff_max_s=1.0).validate()
+        RestartPolicy().validate()  # defaults are valid
+
+
+class TestSupervisor:
+    def test_never_restart_default(self):
+        clock = FakeClock()
+        sup = RestartSupervisor(RestartPolicy(), name="n", time_fn=clock)
+        sup.on_launch()
+        clock.t += 1.0
+        assert sup.on_exit(1) is None
+        assert not sup.gave_up            # "never" is a decision, not a loop
+        assert sup.history[-1].action == "stop"
+
+    def test_clean_exit_never_restarts(self):
+        clock = FakeClock()
+        sup = _sup(clock)
+        sup.on_launch()
+        clock.t += 1.0
+        assert sup.on_exit(0) is None
+        assert sup.history[-1].action == "clean"
+        assert sup.restarts == 0
+
+    def test_crash_loop_walks_schedule_then_gives_up(self):
+        clock = FakeClock()
+        sup = _sup(clock)
+        delays = []
+        for _ in range(10):
+            sup.on_launch()
+            clock.t += 0.01           # instant crasher
+            d = sup.on_exit(1)
+            if d is None:
+                break
+            delays.append(d)
+        assert delays == [0.5, 1.0, 2.0]
+        assert sup.gave_up and sup.restarts == 3
+        assert sup.history[-1].action == "give-up"
+        # once given up, it stays down
+        sup.on_launch()
+        clock.t += 0.01
+        assert sup.on_exit(1) is None
+
+    def test_healthy_uptime_resets_budget(self):
+        clock = FakeClock()
+        sup = _sup(clock)
+        for _ in range(8):            # crashes forever, but slowly
+            sup.on_launch()
+            clock.t += 60.0           # > healthy_uptime_s per life
+            assert sup.on_exit(1) == 0.5   # backoff stays at base
+        assert not sup.gave_up
+
+    def test_signal_exits_labeled(self):
+        clock = FakeClock()
+        sup = _sup(clock)
+        sup.on_launch()
+        clock.t += 0.1
+        sup.on_exit(-9)               # SIGKILL
+        assert sup.history[-1].reason == "signal-9"
+
+    def test_bundle_has_history_and_log_tail(self, tmp_path):
+        clock = FakeClock()
+        sup = _sup(clock, max_restarts=1)
+        for _ in range(3):
+            sup.on_launch()
+            clock.t += 0.01
+            if sup.on_exit(2) is None:
+                break
+        log = tmp_path / "n.log"
+        log.write_text("boot\nboom: the last words\n")
+        path = write_crashloop_bundle(str(tmp_path), sup,
+                                      extras={"why": "test"},
+                                      log_path=str(log))
+        doc = json.loads(open(path).read())
+        assert doc["crashloop"]["gave_up"] is True
+        assert doc["crashloop"]["history"][-1]["action"] == "give-up"
+        assert "last words" in doc["log_tail"]
+        assert doc["extras"]["why"] == "test"
+
+
+class TestManifestKeys:
+    BASE = {
+        "chain_id": "t",
+        "node": {
+            "v0": {"mode": "validator"},
+            "v1": {"mode": "validator"},
+        },
+    }
+
+    def _doc(self, **node_kw):
+        doc = json.loads(json.dumps(self.BASE))
+        doc["node"]["v1"].update(node_kw)
+        return doc
+
+    def test_roundtrip_defaults(self):
+        m = Manifest.from_doc(self._doc())
+        nm = [n for n in m.nodes if n.name == "v1"][0]
+        assert nm.restart_policy == "never"
+        assert nm.fail_point == ""
+        pol = policy_from_manifest(nm)
+        assert pol.policy == "never"
+
+    def test_restart_keys_parse(self):
+        m = Manifest.from_doc(self._doc(restart_policy="on-failure",
+                                        max_restarts=5, backoff_s=0.25))
+        nm = [n for n in m.nodes if n.name == "v1"][0]
+        pol = policy_from_manifest(nm)
+        assert (pol.policy, pol.max_restarts, pol.backoff_s) == \
+            ("on-failure", 5, 0.25)
+        assert pol.schedule()[0] == 0.25
+
+    def test_fail_point_needs_on_failure(self):
+        with pytest.raises(ValueError, match="on-failure"):
+            Manifest.from_doc(self._doc(fail_point="wal.after_fsync"))
+        m = Manifest.from_doc(self._doc(fail_point="wal.after_fsync",
+                                        restart_policy="on-failure"))
+        nm = [n for n in m.nodes if n.name == "v1"][0]
+        assert nm.fail_point == "wal.after_fsync"
+
+    def test_unknown_fail_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fail point"):
+            Manifest.from_doc(self._doc(fail_point="wal.no_such_boundary",
+                                        restart_policy="on-failure"))
+
+    def test_bad_restart_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown restart policy"):
+            Manifest.from_doc(self._doc(restart_policy="sometimes"))
+
+    def test_shipped_manifests_all_load(self):
+        """Every checked-in e2e manifest (ci-crash.toml included) parses
+        and validates — manifest rot fails tier-1, not the first operator
+        who needs it."""
+        import os
+
+        mdir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tendermint_tpu", "e2e", "manifests")
+        names = sorted(n for n in os.listdir(mdir) if n.endswith(".toml"))
+        assert "ci-crash.toml" in names
+        for name in names:
+            m = Manifest.load(os.path.join(mdir, name))
+            assert m.nodes, name
+        crash = Manifest.load(os.path.join(mdir, "ci-crash.toml"))
+        crasher = [n for n in crash.nodes if n.name == "crasher"][0]
+        assert crasher.fail_point == "wal.after_fsync"
+        assert crasher.restart_policy == "on-failure"
+
+    def test_fail_point_env_is_one_shot_across_any_relaunch(self, tmp_path):
+        """TMTPU_FAIL_POINT arms only a node's FIRST launch — supervised
+        restarts AND perturbation relaunches must drop it, or the node
+        dies at the boundary forever."""
+        from tendermint_tpu.e2e.runner import Runner
+
+        m = Manifest.from_doc(self._doc(fail_point="wal.after_fsync",
+                                        restart_policy="on-failure"))
+        r = Runner(m, str(tmp_path))
+        nm = [n for n in m.nodes if n.name == "v1"][0]
+        env1 = r._env(nm, first_launch="v1" not in r._launched)
+        assert env1.get("TMTPU_FAIL_POINT") == "wal.after_fsync"
+        r._launched.add("v1")  # what _launch records on every launch
+        env2 = r._env(nm, first_launch="v1" not in r._launched,
+                      restart_reason="crash")
+        assert "TMTPU_FAIL_POINT" not in env2
+        assert env2["TMTPU_RESTART_REASON"] == "crash"
+
+    def test_fail_points_cover_crashmatrix_catalog(self):
+        """Every boundary the crash matrix enumerates is manifest-armable
+        (the subprocess variant of the same matrix)."""
+        import os
+        import sys
+
+        from tendermint_tpu.libs.fail import KNOWN_FAIL_POINTS
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        try:
+            import crashmatrix
+        finally:
+            sys.path.pop(0)
+        assert set(crashmatrix.ALL_BOUNDARIES) <= KNOWN_FAIL_POINTS

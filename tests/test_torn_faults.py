@@ -1,0 +1,416 @@
+"""Torn-write fault plane (faults.tear) + WAL repair-on-open + the
+crash-at-a-durability-boundary fast subset: torn WAL tails repaired so
+appended records are never stranded, torn privval state refused at load,
+torn db windows retried whole, a mid-group-commit kill replaying the
+durable prefix, and MempoolWAL replay staying idempotent over torn lines.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.libs.db import BufferedDB, MemDB, SQLiteDB
+from tendermint_tpu.libs.faults import FaultPlane, faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- the tear primitive ------------------------------------------------------
+
+class TestTearPrimitive:
+    def test_disabled_passthrough(self):
+        plane = FaultPlane()
+        assert plane.tear("wal.torn_write", b"abc") == b"abc"
+        assert plane.tear_index("db.torn_write", 5) is None
+
+    def test_tear_is_strictly_partial(self):
+        plane = FaultPlane().configure("t.site", seed=3)
+        data = b"A" * 100
+        torn = plane.tear("t.site", data)
+        assert torn != data
+        # prefix of the original plus (possibly) garbage; the original
+        # payload never survives whole
+        cut = 0
+        while cut < min(len(torn), len(data)) and torn[cut] == data[cut]:
+            cut += 1
+        assert cut < len(data)
+
+    def test_deterministic_per_seed(self):
+        # the i-th draw of a site replays identically for a seed
+        p1 = FaultPlane().configure("s", seed=7)
+        p2 = FaultPlane().configure("s", seed=7)
+        for _ in range(10):
+            assert p1.tear("s", b"x" * 33) == p2.tear("s", b"x" * 33)
+            assert p1.tear_index("s", 20) == p2.tear_index("s", 20)
+        # and a different seed produces a different schedule
+        a = [FaultPlane().configure("s", seed=7).tear("s", bytes(64))
+             for _ in range(1)]
+        b = [FaultPlane().configure("s", seed=8).tear("s", bytes(64))
+             for _ in range(1)]
+        assert a != b
+
+    def test_tear_index_bounds(self):
+        plane = FaultPlane().configure("s", seed=1)
+        for n in (1, 2, 17):
+            cut = plane.tear_index("s", n)
+            assert cut is not None and 0 <= cut < n
+        assert plane.tear_index("s", 0) is None
+
+    def test_empty_payload_passthrough(self):
+        plane = FaultPlane().configure("s", seed=1)
+        assert plane.tear("s", b"") == b""
+
+    def test_new_sites_are_known(self):
+        from tendermint_tpu.libs.faults import is_known_site
+
+        for site in ("wal.torn_write", "db.torn_write",
+                     "privval.torn_state", "mempool.wal_torn"):
+            assert is_known_site(site), site
+
+
+# --- WAL repair-on-open ------------------------------------------------------
+
+class TestWALRepair:
+    def _records(self, path):
+        return [m.data["height"] for m in WAL(path, repair=False)
+                .iter_messages() if m.type == "end_height"]
+
+    def test_clean_open_repairs_nothing(self, tmp_path):
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        wal.write_end_height(1, 1)
+        wal.close()
+        wal2 = WAL(path)
+        assert wal2.repairs == 0 and wal2.repaired_bytes == 0
+
+    def test_garbage_tail_truncated_and_appends_replayable(self, tmp_path):
+        """The stranded-records regression: garbage after the last good
+        record used to swallow every subsequent append at replay time."""
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        wal.write_end_height(1, 1)
+        wal.close()
+        good_size = os.path.getsize(path)
+        with open(path, "ab") as f:
+            f.write(b"\xde\xad\xbe\xef garbage")
+        # without repair, an append after the garbage is stranded
+        assert self._records(path) == [0, 1]
+        wal2 = WAL(path)  # repair-on-open
+        assert wal2.repairs == 1
+        assert wal2.repaired_bytes == os.path.getsize(path) - good_size \
+            or os.path.getsize(path) >= good_size
+        wal2.write_end_height(2, 2)
+        wal2.close()
+        assert self._records(path) == [0, 1, 2]
+
+    def test_torn_frame_tail_truncated(self, tmp_path):
+        """A partial frame (valid-looking header, short payload) — the
+        exact shape faults.tear leaves — is repaired the same way."""
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        wal.write_end_height(1, 1)
+        wal.close()
+        payload = json.dumps({"time_ns": 9, "type": "end_height",
+                              "data": {"height": 2}}).encode()
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        frame = struct.pack(">II", crc, len(payload)) + payload
+        with open(path, "ab") as f:
+            f.write(frame[:len(frame) // 2])   # torn mid-payload
+        wal2 = WAL(path)
+        assert wal2.repairs == 1
+        wal2.write_end_height(3, 3)
+        wal2.close()
+        assert self._records(path) == [0, 1, 3]
+
+    def test_armed_tear_site_end_to_end(self, tmp_path):
+        """Arm the production byte-emit site: the torn append never
+        replays whole, and a reopen + append keeps the log usable."""
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        for h in range(1, 4):
+            wal.write_end_height(h, h)
+        faults.configure("wal.torn_write*1", seed=5)
+        wal.write_end_height(4, 4)
+        assert faults.fires("wal.torn_write") == 1
+        faults.reset()
+        wal.close()
+        replayed = self._records(path)
+        assert replayed[:4] == [0, 1, 2, 3] and 4 not in replayed
+        wal2 = WAL(path)
+        wal2.write_end_height(5, 5)
+        wal2.close()
+        assert self._records(path)[-1] == 5
+
+    def test_corrupt_mid_file_not_silently_truncated_by_reader(self, tmp_path):
+        """iter_messages (read path) still stops at corruption without
+        modifying the file — only an append-mode open repairs."""
+        path = str(tmp_path / "w.wal")
+        wal = WAL(path)
+        wal.write_end_height(1, 1)
+        wal.write_end_height(2, 2)
+        wal.close()
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        size = os.path.getsize(path)
+        assert self._records(path) == [0, 1]
+        assert os.path.getsize(path) == size  # repair=False never truncates
+
+
+def test_group_kill_commits_nothing_posthumously(tmp_path):
+    """The in-proc kill (KilledAtFailPoint) must behave like process
+    death inside a group: the context exit flushes NOTHING — otherwise
+    the mid-group-commit boundary is vacuously durable."""
+    from tendermint_tpu.libs import fail
+
+    path = str(tmp_path / "k.wal")
+    wal = WAL(path)
+    wal.write_end_height(1, 1)            # durable pre-group record
+    size0 = os.path.getsize(path)
+    fail.arm_raise("wal.mid_group_commit")
+    with pytest.raises(fail.KilledAtFailPoint):
+        with wal.group():
+            wal.write_end_height(2, 2)
+            wal.write_end_height(3, 3)    # 2nd group record -> boundary
+    assert fail.killed_at() == "wal.mid_group_commit"
+    # the batch stayed in the userspace buffer: no posthumous flush
+    assert os.path.getsize(path) == size0
+    # a later group on the same (still-live-in-test) handle works again
+    fail.reset()
+    with wal.group():
+        wal.write_end_height(4, 4)
+    wal.close()
+    heights = [m.data["height"] for m in WAL(path).iter_messages()
+               if m.type == "end_height"]
+    assert heights[-1] == 4
+
+
+def test_mid_group_commit_kill_replays_durable_prefix(tmp_path):
+    """Kill a subprocess at the wal.mid_group_commit fail point: records
+    appended before the kill that reached the OS replay; the batch's
+    unflushed remainder is gone; repair-on-open + a fresh append work."""
+    path = str(tmp_path / "g.wal")
+    script = f"""
+import os
+from tendermint_tpu.consensus.wal import WAL
+wal = WAL({path!r})
+wal.write_end_height(1, 1)          # durable pre-group record
+with wal.group():
+    wal.write_end_height(2, 2)      # appended, flush pending
+    wal.write_end_height(3, 3)      # second group record -> fail point
+raise SystemExit("fail point should have killed us")
+"""
+    env = dict(os.environ, TMTPU_FAIL_POINT="wal.mid_group_commit",
+               JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", script], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, (proc.returncode, proc.stderr)
+    assert "wal.mid_group_commit" in proc.stderr
+    # replay: the pre-group record is there; the group's records died
+    # buffered (os._exit discards userspace buffers — the SIGKILL analog)
+    heights = [m.data["height"] for m in WAL(path, repair=False)
+               .iter_messages() if m.type == "end_height"]
+    assert heights[:2] == [0, 1], heights
+    assert 3 not in heights
+    # restart appends cleanly after repair-on-open
+    wal = WAL(path)
+    wal.write_end_height(9, 9)
+    wal.close()
+    heights = [m.data["height"] for m in WAL(path).iter_messages()
+               if m.type == "end_height"]
+    assert heights[-1] == 9
+
+
+# --- torn db window ----------------------------------------------------------
+
+class TestTornDBWindow:
+    def test_memdb_window_retried_whole(self):
+        base = MemDB()
+        buf = BufferedDB(base)
+        keys = [b"k%02d" % i for i in range(20)]
+        for k in keys:
+            buf.set(k, b"v" + k)
+        faults.configure("db.torn_write*1", seed=2)
+        with pytest.raises(OSError):
+            buf.flush()
+        fired = faults.fires("db.torn_write")
+        faults.reset()
+        assert fired == 1
+        # a PREFIX may have landed (torn), but the staged window survives
+        # and the disarmed retry lands every record (idempotent upserts)
+        assert buf.pending() > 0
+        buf.flush()
+        for k in keys:
+            assert base.get(k) == b"v" + k, f"record lost across retry: {k}"
+
+    def test_sqlite_window_rolls_back_then_retried_whole(self, tmp_path):
+        base = SQLiteDB(str(tmp_path / "t.db"))
+        buf = BufferedDB(base)
+        keys = [b"s%02d" % i for i in range(20)]
+        for k in keys:
+            buf.set(k, b"v" + k)
+        faults.configure("db.torn_write*1", seed=2)
+        with pytest.raises(OSError):
+            buf.flush()
+        faults.reset()
+        # transactional base: the torn batch left NOTHING behind
+        assert all(base.get(k) is None for k in keys)
+        buf.flush()
+        for k in keys:
+            assert base.get(k) == b"v" + k
+        base.close()
+
+
+# --- torn privval state ------------------------------------------------------
+
+class TestTornPrivvalState:
+    def _pv(self, tmp_path, seed=b"\x11"):
+        from tendermint_tpu.privval.file_pv import FilePV
+
+        key = str(tmp_path / "pv_key.json")
+        state = str(tmp_path / "pv_state.json")
+        pv = FilePV.generate(key, state, seed=seed * 32)
+        pv.save()
+        return pv, key, state
+
+    def _vote(self, h):
+        from tendermint_tpu.types import (BlockID, PartSetHeader,
+                                          SignedMsgType, Vote)
+
+        bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+        return Vote(SignedMsgType.PREVOTE, h, 0, bid,
+                    1_700_000_000_000_000_000, b"\xaa" * 20, 0)
+
+    def test_torn_state_refused_with_actionable_error(self, tmp_path):
+        from tendermint_tpu.privval.file_pv import (CorruptSignStateError,
+                                                    FilePV)
+
+        pv, key, state = self._pv(tmp_path)
+        pv.sign_vote("chain", self._vote(1))
+        faults.configure("privval.torn_state*1", seed=4)
+        pv.sign_vote("chain", self._vote(2))   # the save is torn
+        faults.reset()
+        with pytest.raises(CorruptSignStateError) as ei:
+            FilePV.load(key, state)
+        msg = str(ei.value)
+        assert state in msg and "double-sign" in msg
+
+    def test_corrupt_state_never_silently_resets(self, tmp_path):
+        from tendermint_tpu.privval.file_pv import (CorruptSignStateError,
+                                                    FilePV)
+
+        pv, key, state = self._pv(tmp_path, seed=b"\x12")
+        pv.sign_vote("chain", self._vote(5))
+        with open(state, "w") as f:
+            f.write('{"height": ')  # torn json
+        with pytest.raises(CorruptSignStateError):
+            FilePV.load(key, state)
+
+    def test_missing_state_file_warns_loudly(self, tmp_path, caplog):
+        import logging
+
+        from tendermint_tpu.privval.file_pv import FilePV
+
+        pv, key, state = self._pv(tmp_path, seed=b"\x13")
+        pv.sign_vote("chain", self._vote(3))
+        os.unlink(state)
+        with caplog.at_level(logging.WARNING, logger="tmtpu.privval"):
+            pv2 = FilePV.load(key, state)
+        assert pv2.last_sign_state.height == 0
+        assert any("absent" in r.message for r in caplog.records)
+
+    def test_atomic_write_survives_normal_save_load(self, tmp_path):
+        from tendermint_tpu.privval.file_pv import FilePV
+
+        pv, key, state = self._pv(tmp_path, seed=b"\x14")
+        pv.sign_vote("chain", self._vote(7))
+        pv2 = FilePV.load(key, state)
+        assert pv2.last_sign_state.height == 7
+
+
+# --- torn mempool WAL --------------------------------------------------------
+
+class TestTornMempoolWAL:
+    def _mempool(self, wal_dir=None):
+        from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+        from tendermint_tpu.mempool import CListMempool
+        from tendermint_tpu.mempool.clist_mempool import init_mempool_wal
+        from tendermint_tpu.proxy import AppConns, local_client_creator
+
+        conns = AppConns(local_client_creator(KVStoreApplication()))
+        conns.start()
+        mp = CListMempool(conns.mempool, max_txs=10000)
+        if wal_dir is not None:
+            init_mempool_wal(mp, wal_dir)
+        return mp, conns
+
+    def test_partial_tail_never_merges_with_next_append(self, tmp_path):
+        """Repair-on-open: a newline-less torn tail must be truncated at
+        the next open — appending after it would merge two hex lines into
+        one (often still-valid!) bogus tx and lose the real one."""
+        from tendermint_tpu.mempool.clist_mempool import (MempoolWAL,
+                                                          init_mempool_wal)
+        from tendermint_tpu.mempool.ingest import replay_mempool_wal
+
+        wal_dir = str(tmp_path / "mwal")
+        mp, conns = self._mempool(wal_dir)
+        try:
+            mp.check_tx(b"aa=1")
+            mp._wal.close()
+        finally:
+            conns.stop()
+        path = os.path.join(wal_dir, "wal")
+        with open(path, "ab") as f:
+            f.write(b"beef")          # torn line, no newline
+        # reopen via the production path; the torn fragment is truncated
+        MempoolWAL(wal_dir).close()
+        assert open(path, "rb").read().endswith(b"\n")
+        mp2, conns2 = self._mempool(wal_dir)
+        try:
+            mp2.check_tx(b"bb=2")     # appended post-repair
+            mp2._wal.close()
+        finally:
+            conns2.stop()
+        fresh, conns3 = self._mempool()
+        try:
+            replayed, _ = replay_mempool_wal(fresh, wal_dir)
+            assert replayed == 2
+            txs = {bytes(tx) for tx in fresh.reap_max_txs(10)}
+            assert txs == {b"aa=1", b"bb=2"}, txs  # no merged bogus tx
+        finally:
+            conns3.stop()
+
+    def test_torn_line_skipped_and_replay_idempotent(self, tmp_path):
+        from tendermint_tpu.mempool.ingest import replay_mempool_wal
+
+        wal_dir = str(tmp_path / "mwal")
+        mp, conns = self._mempool(wal_dir)
+        try:
+            for i in range(8):
+                mp.check_tx(b"tx%02d=v" % i)
+            # tear the LAST line (the tail a crash would tear)
+            faults.configure("mempool.wal_torn*1", seed=6)
+            mp.check_tx(b"torn-tail=v")
+            assert faults.fires("mempool.wal_torn") == 1
+            faults.reset()
+            mp._wal.close()
+        finally:
+            conns.stop()
+
+        fresh, conns2 = self._mempool()
+        try:
+            replayed1, skipped1 = replay_mempool_wal(fresh, wal_dir)
+            assert replayed1 >= 8  # the intact prefix re-admits
+            # idempotency: a second replay admits NOTHING new
+            replayed2, skipped2 = replay_mempool_wal(fresh, wal_dir)
+            assert replayed2 == 0
+            assert skipped2 >= replayed1
+        finally:
+            conns2.stop()

@@ -426,6 +426,39 @@ def self_test() -> int:
         # the scaling breakdown stays informational (never gated)
         assert gate_direction("inproc_churn_gossip_scaling_breakdown",
                               "ratio") is None
+        # the crash-recovery row gates lower-better in BOTH directions: a
+        # kill→caught-up blow-up regresses, a big speedup reads improved,
+        # a vanished row fails, and a crashed config reads errored
+        cr_base = os.path.join(d, "crash_base.json")
+        _write(cr_base, {"inproc_crash4_kill_caughtup_s": (5.0, "s")})
+        cr_bad = os.path.join(d, "crash_bad.json")
+        _write(cr_bad, {"inproc_crash4_kill_caughtup_s": (20.0, "s")})
+        assert main([cr_base, cr_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(cr_base), load_bench(cr_bad), {})}
+        assert rows["inproc_crash4_kill_caughtup_s"][
+            "status"] == "regressed"
+        cr_fast = os.path.join(d, "crash_fast.json")
+        _write(cr_fast, {"inproc_crash4_kill_caughtup_s": (2.0, "s")})
+        rows = {r["metric"]: r for r in compare(
+            load_bench(cr_base), load_bench(cr_fast), {})}
+        assert rows["inproc_crash4_kill_caughtup_s"]["status"] == "improved"
+        assert main([cr_base, cr_fast]) == 0
+        cr_gone = os.path.join(d, "crash_gone.json")
+        _write(cr_gone, {"unrelated_row": (1.0, "s")})
+        assert main([cr_base, cr_gone]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(cr_base), load_bench(cr_gone), {})}
+        assert rows["inproc_crash4_kill_caughtup_s"]["status"] == "missing"
+        cr_err = os.path.join(d, "crash_err.json")
+        _write(cr_err, {"inproc_crash4_kill_caughtup_s": (0.0, "error")})
+        assert main([cr_base, cr_err]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(cr_base), load_bench(cr_err), {})}
+        assert rows["inproc_crash4_kill_caughtup_s"]["status"] == "errored"
+        # ...and a loosened per-metric threshold un-trips the regression
+        assert main(["--threshold", "inproc_crash4_kill_caughtup_s=9",
+                     cr_base, cr_bad]) == 0
         # the driver's record format ({"tail": jsonl}) parses identically
         drv = os.path.join(d, "driver.json")
         with open(drv, "w") as f:

@@ -66,6 +66,19 @@ Churn cells — membership change as the fault (tools/churn.py rig):
   sparse net survives capped bit flips on in-flight payloads (receivers
   drop corrupting links, the redial loop re-heals), hashes identical
 
+Crash cells — process death as the fault (tools/crashmatrix.py plane):
+
+* crash.torn_wal — seeded torn WAL appends (``wal.torn_write``): replay
+  stops at the tear, repair-on-open truncates the undecodable tail, and
+  records appended AFTER the repair are never stranded behind garbage
+* crash.privval  — a torn last-sign-state write (``privval.torn_state``):
+  FilePV.load refuses to start with an actionable error naming the file
+  (never a silent height-0 reset — that is the double-sign hazard)
+* crash.loop     — the restart supervisor against an instant crasher:
+  bounded exponential backoff walks its schedule, give-up fires after
+  max_restarts consecutive fast crashes, and the crash-loop debugdump
+  bundle records the full exit history
+
     python tools/chaos_matrix.py                     # full matrix
     python tools/chaos_matrix.py --quick             # skip the net cells
     python tools/chaos_matrix.py --sites statesync.lying_chunk --seeds 1,2
@@ -111,6 +124,10 @@ SITES = {
     "churn.rotate": True,
     "churn.partition32": True,
     "churn.corrupt32": True,
+    # crash cells (process death as the fault; tools/crashmatrix.py plane)
+    "crash.torn_wal": False,
+    "crash.privval": False,
+    "crash.loop": False,
 }
 
 
@@ -925,6 +942,137 @@ def cell_churn_corrupt32(seed: int) -> None:
     _net32(seed, drive)
 
 
+def cell_crash_torn_wal(seed: int) -> None:
+    """Torn WAL tail, repaired on open: arm the byte-emit tear site so the
+    LAST append lands partial, prove replay stops at the tear, and prove a
+    reopen truncates the garbage so new appends are replayable (the
+    stranded-records regression the repair exists for)."""
+    import tempfile
+
+    from tendermint_tpu.consensus.wal import WAL
+    from tendermint_tpu.libs.faults import faults
+
+    path = os.path.join(tempfile.mkdtemp(prefix="chaos-torn-"), "cs.wal")
+    wal = WAL(path)
+    for h in range(1, 6):
+        wal.write_end_height(h, 1_700_000_000_000_000_000 + h)
+    # tear exactly the NEXT append (the tail record a crash would tear)
+    faults.configure("wal.torn_write*1", seed=seed)
+    wal.write_end_height(6, 1_700_000_000_000_000_006)
+    assert faults.fires("wal.torn_write") == 1, "tear site never fired"
+    faults.reset()
+    wal.close()
+    # replay stops cleanly at (or before) the torn record
+    replayed = [m.data["height"] for m in WAL(path, repair=False)
+                .iter_messages() if m.type == "end_height"]
+    assert replayed[:6] == [0, 1, 2, 3, 4, 5], replayed
+    assert 6 not in replayed, "a torn record must never replay whole"
+    # repair-on-open: append after the tear, the new record must replay
+    wal2 = WAL(path)
+    size_after_repair = os.path.getsize(path)
+    assert WAL._decodable_prefix_len(
+        open(path, "rb").read()) == size_after_repair, \
+        "repair left undecodable bytes in the head"
+    wal2.write_end_height(7, 1_700_000_000_000_000_007)
+    wal2.close()
+    replayed = [m.data["height"] for m in WAL(path).iter_messages()
+                if m.type == "end_height"]
+    assert replayed[-1] == 7, \
+        f"record appended after repair was stranded: {replayed}"
+    # determinism: the same seed tears the same bytes
+    fp1 = faults.configure("wal.torn_write*1", seed=seed).tear(
+        "wal.torn_write", b"A" * 64)
+    faults.reset()
+    fp2 = faults.configure("wal.torn_write*1", seed=seed).tear(
+        "wal.torn_write", b"A" * 64)
+    faults.reset()
+    assert fp1 == fp2, "tear schedule not deterministic per seed"
+
+
+def cell_crash_privval(seed: int) -> None:
+    """Torn last-sign-state: the atomic write emits a partial file, and
+    the next startup REFUSES with an error naming the file — never a
+    silent height-0 reset (the double-sign hazard)."""
+    import tempfile
+
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.privval.file_pv import CorruptSignStateError, FilePV
+    from tendermint_tpu.types import (BlockID, PartSetHeader, SignedMsgType,
+                                      Vote)
+
+    d = tempfile.mkdtemp(prefix="chaos-pv-")
+    key, state = os.path.join(d, "pv_key.json"), os.path.join(d, "pv_state.json")
+    pv = FilePV.generate(key, state, seed=bytes([seed & 0xFF]) * 32)
+    pv.save()
+    bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+
+    def vote(h):
+        return Vote(SignedMsgType.PREVOTE, h, 0, bid,
+                    1_700_000_000_000_000_000, b"\xaa" * 20, 0)
+
+    pv.sign_vote("chaos-chain", vote(1))          # clean sign + save
+    faults.configure("privval.torn_state*1", seed=seed)
+    pv.sign_vote("chaos-chain", vote(2))          # state write torn
+    assert faults.fires("privval.torn_state") == 1, "tear site never fired"
+    faults.reset()
+    try:
+        FilePV.load(key, state)
+        raise AssertionError("corrupt sign state silently accepted")
+    except CorruptSignStateError as e:
+        assert state in str(e), f"error does not name the file: {e}"
+        assert "double-sign" in str(e), e
+    # after the operator restores the file, startup works again
+    pv.last_sign_state.save()                     # un-torn rewrite
+    pv2 = FilePV.load(key, state)
+    assert pv2.last_sign_state.height == 2
+
+
+def cell_crash_loop(seed: int) -> None:
+    """Crash-loop give-up: an instant crasher walks the bounded backoff
+    schedule, exhausts max_restarts, and the supervisor gives up with a
+    debugdump bundle holding the exit history."""
+    import json
+    import tempfile
+
+    from tendermint_tpu.libs.supervisor import (RestartPolicy,
+                                                RestartSupervisor,
+                                                write_crashloop_bundle)
+
+    clock = [0.0]
+    policy = RestartPolicy(policy="on-failure", max_restarts=3,
+                           backoff_s=0.5, backoff_max_s=4.0,
+                           healthy_uptime_s=10.0)
+    sup = RestartSupervisor(policy, name=f"crasher{seed}",
+                            time_fn=lambda: clock[0])
+    delays = []
+    for _ in range(10):
+        sup.on_launch()
+        clock[0] += 0.01            # dies instantly every time
+        delay = sup.on_exit(1)
+        if delay is None:
+            break
+        delays.append(delay)
+    assert sup.gave_up, "supervisor never gave up on an instant crasher"
+    assert delays == [0.5, 1.0, 2.0], delays   # bounded doubling
+    assert sup.restarts == policy.max_restarts
+    # a healthy run re-earns the budget (not a crash loop)
+    sup2 = RestartSupervisor(policy, name="occasional",
+                             time_fn=lambda: clock[0])
+    for _ in range(6):
+        sup2.on_launch()
+        clock[0] += 60.0            # an hour of uptime per life
+        assert sup2.on_exit(1) == 0.5
+    assert not sup2.gave_up
+    # the give-up artifact records the whole history
+    out = tempfile.mkdtemp(prefix="chaos-loop-")
+    bundle = write_crashloop_bundle(out, sup, extras={"seed": str(seed)})
+    with open(bundle) as f:
+        doc = json.load(f)
+    assert doc["crashloop"]["gave_up"] is True
+    assert len(doc["crashloop"]["history"]) == policy.max_restarts + 1
+    assert doc["crashloop"]["history"][-1]["action"] == "give-up"
+
+
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
     "device.lane": cell_device_lane,
@@ -943,6 +1091,9 @@ CELLS = {
     "churn.rotate": cell_churn_rotate,
     "churn.partition32": cell_churn_partition32,
     "churn.corrupt32": cell_churn_corrupt32,
+    "crash.torn_wal": cell_crash_torn_wal,
+    "crash.privval": cell_crash_privval,
+    "crash.loop": cell_crash_loop,
 }
 assert set(CELLS) == set(SITES)
 
@@ -1011,6 +1162,12 @@ def self_test() -> None:
     # churn plumbing: the plan the churn cells execute is deterministic
     churn = _churn_mod()
     assert churn.plan_churn(3, 2, 8) == churn.plan_churn(3, 2, 8)
+    # the crash cells are jax-free and fast: run them in-process too
+    cell_crash_torn_wal(seed=1)
+    faults.reset()
+    cell_crash_privval(seed=1)
+    faults.reset()
+    cell_crash_loop(seed=1)
     print("chaos_matrix self-test OK")
 
 
