@@ -1265,6 +1265,53 @@ def bench_multichip_scale():
           table=res["table"], cell_errors=res.get("cell_errors"))
 
 
+def bench_exec():
+    """Config exec: the parallel-execution plane, measured (tools/
+    execbench.py in-proc rig — no subprocess fleet, so it runs in slim
+    containers).
+
+    Gated row, from a seeded 4-validator in-proc fleet under an open-loop
+    firehose of large-value disjoint-key txs (the payload where block
+    execution dominates block time and speculation has maximum
+    parallelism):
+
+    * inproc_exec4_committed_txs_per_sec — committed txs/sec with
+      execution.version=v1 (higher better). The A/B payload carries the
+      matching SERIAL (v0) rate and the speedup: on a multi-core host the
+      serial run visibly saturates first; on a 1-core host the executor
+      caps its workers and the two rates converge (n_cpus says which
+      world the row came from). Both fleets must land on the same app
+      hash — the byte-parity invariant observed end-to-end.
+
+    Informational row: inproc_exec4_phase_breakdown — the exec-plane
+    phase decomposition of the parallel run's measured window (the
+    per-block plane="exec" segments: validate=pack, tx execution=
+    in-flight, commit+persist=fetch), same interval-union accounting as
+    the device-plane profiles."""
+    eb = _tools_mod("execbench")
+
+    try:
+        rep = eb.run_exec_ab(seed=1)
+        par, ser = rep["parallel"], rep["serial"]
+        _emit("inproc_exec4_committed_txs_per_sec", par["txs_per_sec"],
+              "txs/s", rep["speedup"],
+              serial_txs_per_sec=round(ser["txs_per_sec"], 3),
+              speedup=round(rep["speedup"], 3), n_cpus=rep["n_cpus"],
+              n_txs=rep["n_txs"], value_size=rep["value_size"],
+              groups=par["parallel"]["groups"],
+              conflicted=par["parallel"]["conflicted"],
+              heights=par["heights"], app_hash=par["app_hash"])
+        bd = par["exec_phase"]
+        _emit("inproc_exec4_phase_breakdown",
+              bd.get("device_share", 0.0), "ratio", 0.0,
+              parallel=bd, serial=ser["exec_phase"])
+    except Exception as e:
+        _emit("inproc_exec4_committed_txs_per_sec", 0.0, "error", 0.0,
+              error=f"{type(e).__name__}: {e}")
+        _emit("inproc_exec4_phase_breakdown", 0.0, "error", 0.0,
+              error=f"{type(e).__name__}: {e}")
+
+
 CONFIGS = {
     "1": bench_stream,
     "2": bench_verify_commit_150,
@@ -1275,6 +1322,7 @@ CONFIGS = {
     "multichip": bench_multichip_scale,
     "churn": bench_churn,
     "crash": bench_crash,
+    "exec": bench_exec,
     "10k": bench_verify_commit_10k,
 }
 
@@ -1320,8 +1368,8 @@ if __name__ == "__main__":
             # flagship last: the driver records the final line. The remote
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
-            for key in ("2", "3", "4", "ingest", "churn", "crash", "5", "1",
-                        "multichip", "10k"):
+            for key in ("2", "3", "4", "ingest", "churn", "crash", "exec",
+                        "5", "1", "multichip", "10k"):
                 for attempt in (1, 2):
                     try:
                         with _tracer.span(f"config_{key}"):

@@ -20,6 +20,10 @@ VALIDATOR_TX_PREFIX = "val:"
 
 
 class KVStoreApplication(Application):
+    #: speculation protocol below (spec_read / deliver_tx_on_view /
+    #: apply_spec_ops) — see abci/application.py for the contract
+    parallel_exec_supported = True
+
     def __init__(self):
         self.state: Dict[str, str] = {}
         self.tx_count = 0  # deterministic state size counter
@@ -83,13 +87,70 @@ class KVStoreApplication(Application):
                 k, v = raw.split("=", 1)
             else:
                 k = v = raw
-            self.state[k] = v
+            self._set_kv(k, v)
         self.tx_count += 1
-        events = [abci.Event(type="app", attributes=[
-            abci.EventAttribute(b"creator", b"tendermint_tpu", True),
-            abci.EventAttribute(b"key", req.tx.split(b"=", 1)[0], True),
-        ])]
-        return abci.ResponseDeliverTx(code=0, events=events, gas_wanted=1, gas_used=1)
+        return abci.ResponseDeliverTx(code=0, events=_tx_events(req.tx),
+                                      gas_wanted=1, gas_used=1)
+
+    def _set_kv(self, k: str, v: str, vhash: Optional[bytes] = None) -> None:
+        """Single store-write seam: MerkleKVStoreApplication hooks it for
+        value-hash caching + dirty-leaf tracking. ``vhash`` is sha256(v)
+        when the caller already computed it (the speculative path hashes
+        in parallel worker threads), else recomputed where needed."""
+        self.state[k] = v
+
+    # -- optimistic parallel execution (state/parallel.py) -----------------
+
+    def spec_read(self, space: str, key: str):
+        if space == "kv":
+            return self.state.get(key)
+        if space == "val":
+            return self.validators.get(key)
+        return None
+
+    def deliver_tx_on_view(self, tx: bytes, view) -> abci.ResponseDeliverTx:
+        """deliver_tx's speculation twin: same decision logic and response
+        bytes, state effects recorded on the view instead of applied.
+        Value hashing happens HERE — in the speculating worker thread,
+        where hashlib releases the GIL for large values — so the serial
+        apply/commit path never recomputes it."""
+        if tx_is_validator_update(tx):
+            parsed = parse_validator_tx(tx)
+            if parsed is None:
+                return abci.ResponseDeliverTx(code=1,
+                                              log="malformed validator tx")
+            pubkey_hex, power = parsed
+            view.write("val", pubkey_hex, power)
+            # shared ordered stream: cross-group validator updates always
+            # conflict, so mixed-order val_updates are impossible
+            view.emit("vup", (pubkey_hex, power))
+        else:
+            raw = tx.decode("utf-8", errors="replace")
+            if "=" in raw:
+                k, v = raw.split("=", 1)
+            else:
+                k = v = raw
+            view.write("kv", k, v, extra=hashlib.sha256(v.encode()).digest())
+        view.add("tx_count", 1)
+        return abci.ResponseDeliverTx(code=0, events=_tx_events(tx),
+                                      gas_wanted=1, gas_used=1)
+
+    def apply_spec_ops(self, ops) -> None:
+        for op in ops:
+            kind = op[0]
+            if kind == "set":
+                _, space, key, value, extra = op
+                if space == "kv":
+                    self._set_kv(key, value, extra)
+                else:  # "val"
+                    self.validators[key] = value
+            elif kind == "emit":  # ("emit", "vup", (pubkey_hex, power))
+                pubkey_hex, power = op[2]
+                self.val_updates.append(abci.ValidatorUpdate(
+                    pub_key_type="ed25519",
+                    pub_key_bytes=bytes.fromhex(pubkey_hex), power=power))
+            else:  # ("add", "tx_count", n)
+                self.tx_count += op[2]
 
     def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         return abci.ResponseEndBlock(validator_updates=list(self.val_updates))
@@ -208,6 +269,13 @@ def _parse_chunk_hashes(snap: abci.Snapshot) -> Optional[List[str]]:
     return hashes
 
 
+def _tx_events(tx: bytes) -> List[abci.Event]:
+    return [abci.Event(type="app", attributes=[
+        abci.EventAttribute(b"creator", b"tendermint_tpu", True),
+        abci.EventAttribute(b"key", tx.split(b"=", 1)[0], True),
+    ])]
+
+
 def tx_is_validator_update(tx: bytes) -> bool:
     return tx.decode("utf-8", errors="replace").startswith(VALIDATOR_TX_PREFIX)
 
@@ -235,10 +303,43 @@ class MerkleKVStoreApplication(SnapshotKVStoreApplication):
     The proof at query height H verifies against the app hash carried in
     HEADER H+1 (AppHash(H+1) = Commit(H) result), exactly the reference's
     height convention.
+
+    Commit cost: the root is maintained by crypto.merkle.IncrementalMerkle
+    — only leaves whose value changed since the last commit re-hash
+    (``_dirty``), the level reduce vectorizes through the crypto plane's
+    batched SHA-256 when the tree is large, and ``TMTPU_MERKLE_FAST=0``
+    forces the recursive spec recompute (byte-identical by construction
+    and by differential test).
     """
+
+    def __init__(self, interval: int = 4):
+        super().__init__(interval)
+        self._vhash: Dict[str, bytes] = {}  # key -> sha256(value)
+        self._dirty: set = set()            # keys written since last commit
+        from ...crypto.merkle import IncrementalMerkle
+
+        self._imt = IncrementalMerkle()
+
+    def _set_kv(self, k: str, v: str, vhash: Optional[bytes] = None) -> None:
+        self.state[k] = v
+        self._vhash[k] = vhash if vhash is not None \
+            else hashlib.sha256(v.encode()).digest()
+        self._dirty.add(k)
+
+    def _leaf_item(self, k: str) -> bytes:
+        from ...crypto.merkle import _encode_byte_slice
+
+        vh = self._vhash.get(k)
+        if vh is None:  # state poked behind _set_kv (tests, tools)
+            vh = hashlib.sha256(self.state[k].encode()).digest()
+            self._vhash[k] = vh
+        return (_encode_byte_slice(k.encode())
+                + _encode_byte_slice(vh))
 
     @staticmethod
     def _leaf_items(state: Dict[str, str]) -> List[bytes]:
+        """The SPEC leaf encoding (proof_value.go ValueOp), recomputed
+        from scratch — the incremental path must match it byte-for-byte."""
         from ...crypto.merkle import _encode_byte_slice
 
         items = []
@@ -248,11 +349,27 @@ class MerkleKVStoreApplication(SnapshotKVStoreApplication):
                          + _encode_byte_slice(vhash))
         return items
 
+    def _reset_merkle_cache(self) -> None:
+        """Rebuild value-hash cache + drop the level cache (snapshot
+        restore and any other out-of-band state swap)."""
+        self._vhash = {k: hashlib.sha256(v.encode()).digest()
+                       for k, v in self.state.items()}
+        self._dirty = set()
+        self._imt.reset()
+
     def commit(self) -> abci.ResponseCommit:
-        from ...crypto.merkle import hash_from_byte_slices
+        import os
 
         resp = super().commit()
-        self.app_hash = hash_from_byte_slices(self._leaf_items(self.state))
+        if os.environ.get("TMTPU_MERKLE_FAST", "1") == "0":
+            from ...crypto.merkle import hash_from_byte_slices
+
+            self.app_hash = hash_from_byte_slices(
+                self._leaf_items(self.state))
+        else:
+            self.app_hash = self._imt.root(sorted(self.state),
+                                           self._leaf_item, self._dirty)
+        self._dirty = set()
         return abci.ResponseCommit(data=self.app_hash)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
@@ -260,16 +377,20 @@ class MerkleKVStoreApplication(SnapshotKVStoreApplication):
         # proofs exist only for the KV store path; /val and missing keys
         # answer unproven (the light proxy then refuses to vouch for them)
         key = req.data.decode("utf-8", errors="replace")
+        # queries run on their own connection lock (proxy.py) and may
+        # interleave with a block mid-apply: take one atomic snapshot of
+        # the store instead of iterating the live dict
+        snap = dict(self.state)
         if (req.prove and resp.code == 0 and resp.value
-                and req.path in ("", "/store") and key in self.state):
+                and req.path in ("", "/store") and key in snap):
             from ...crypto.merkle import (
                 ProofOp,
                 ValueOp,
                 proofs_from_byte_slices,
             )
 
-            idx = sorted(self.state).index(key)
-            proof = proofs_from_byte_slices(self._leaf_items(self.state))[idx]
+            idx = sorted(snap).index(key)
+            proof = proofs_from_byte_slices(self._leaf_items(snap))[idx]
             op = ValueOp(req.data, proof).proof_op()
             resp.proof_ops = [ProofOp(op.type, op.key, op.data)]
         return resp
@@ -282,6 +403,7 @@ class MerkleKVStoreApplication(SnapshotKVStoreApplication):
         if (self._restore is None
                 and resp.result == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT):
             # restore completed: the app hash is the merkle root, not the
-            # parent's tx-count encoding
+            # parent's tx-count encoding; the incremental cache is stale
+            self._reset_merkle_cache()
             self.app_hash = hash_from_byte_slices(self._leaf_items(self.state))
         return resp

@@ -149,6 +149,21 @@ class BlockchainReactor(Reactor):
             "inline_windows": int(m.inline_windows_total.value()),
         }
 
+    @staticmethod
+    def exec_phase_breakdown(wall_t0: float, wall_t1: float) -> dict:
+        """Phase decomposition of the EXEC plane over a wall-clock window:
+        state/execution.py records one ``plane="exec"`` segment per applied
+        block (validate=pack, tx execution=in-flight, commit+persist=fetch),
+        so the same interval-union accounting that profiles the device
+        verify plane decomposes block execution — bench's ``exec`` config
+        reports the in-flight (execute) share vs validate/commit overhead.
+        Stage A's verify-commit(H+1) runs concurrently with these segments;
+        its time lives in ``stage_breakdown()`` verify_s, not here."""
+        recs = [r for r in phases.recent_segments()
+                if r.get("plane") == "exec"
+                and wall_t0 <= r["t0"] and r["t_end"] <= wall_t1]
+        return phases.phase_breakdown(recs, wall_t0, wall_t1)
+
     def get_channels(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=5,
                                   send_queue_capacity=1000,

@@ -154,6 +154,21 @@ class FastSyncConfig:
 
 
 @dataclass
+class ExecutionConfig:
+    """Execution plane (state/execution.py + state/parallel.py). No
+    reference analog — tendermint executes DeliverTx serially; this build
+    grows an optimistic parallel path over it."""
+
+    # v1 = optimistic parallel block execution: conflict-grouped
+    # speculation + validation + serial re-execution of conflicts, with
+    # byte-identical outputs and automatic per-block fallback to serial
+    # (state/parallel.py); v0 = the serial spec path only
+    version: str = "v1"
+    workers: int = 4            # speculation thread pool width
+    min_parallel_txs: int = 2   # below this, serial is always cheaper
+
+
+@dataclass
 class StorageConfig:
     """(config/config.go:1081 StorageConfig)"""
 
@@ -183,6 +198,7 @@ class InstrumentationConfig:
 _SECTIONS = [
     ("rpc", RPCConfig), ("p2p", P2PConfig), ("mempool", MempoolConfig),
     ("statesync", StateSyncConfig), ("fastsync", FastSyncConfig),
+    ("execution", ExecutionConfig),
     ("consensus", ConsensusConfig), ("storage", StorageConfig),
     ("tx_index", TxIndexConfig), ("instrumentation", InstrumentationConfig),
 ]
@@ -199,6 +215,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    execution: ExecutionConfig = field(default_factory=ExecutionConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
@@ -260,6 +277,12 @@ class Config:
                 raise ValueError("statesync.trust_height must be set")
         if self.fastsync.version not in ("v0",):
             raise ValueError(f"unknown fastsync version {self.fastsync.version!r}")
+        if self.execution.version not in ("v0", "v1"):
+            raise ValueError(f"unknown execution version {self.execution.version!r}")
+        if self.execution.workers <= 0:
+            raise ValueError("execution.workers must be positive")
+        if self.execution.min_parallel_txs < 0:
+            raise ValueError("execution.min_parallel_txs cannot be negative")
         if self.tx_index.indexer not in ("kv", "null", "psql"):
             raise ValueError(f"unknown indexer {self.tx_index.indexer!r}")
 

@@ -14,6 +14,7 @@ from typing import List, Optional
 
 from ..abci import types as abci
 from ..abci.client import Client
+from ..crypto import phases
 from ..state import BlockExecutor, State, state_from_genesis
 from ..state.execution import exec_commit_block, validator_update_to_validator
 from ..state.store import StateStore
@@ -92,12 +93,17 @@ def _replay_message(cs: ConsensusState, m: WALMessage) -> None:
 class Handshaker:
     def __init__(self, state_store: StateStore, state: State,
                  block_store: BlockStore, genesis: GenesisDoc,
-                 event_bus: Optional[EventBus] = None):
+                 event_bus: Optional[EventBus] = None, exec_config=None):
         self.state_store = state_store
         self.initial_state = state
         self.block_store = block_store
         self.genesis = genesis
         self.event_bus = event_bus
+        # the node's [execution] config: recovery's final apply_block goes
+        # through the same executor version the live node will use, so a
+        # crash mid-parallel-apply replays to the identical hash it would
+        # have produced serially (state/parallel.py byte-parity invariant)
+        self.exec_config = exec_config
         self.n_blocks = 0
 
     def handshake(self, proxy_app_consensus: Client, proxy_app_query: Client) -> State:
@@ -205,8 +211,22 @@ class Handshaker:
         for h in range(first, final_height + 1):
             logger.info("replaying block height=%d", h)
             block = self.block_store.load_block(h)
-            exec_commit_block(consensus_conn, block, self.state_store,
-                              state.initial_height)
+            # exec-plane segment per replayed block: handshake replay shows
+            # up in the same phase breakdown as live apply (execution.py
+            # tags its own), so recovery time decomposes like block time
+            n_txs = len(block.data.txs)
+            _seg = phases.Segment(sigs=n_txs, chunk=n_txs, device="app",
+                                  plane="exec", height=h)
+            _seg.begin()
+            try:
+                _seg.pack_done()
+                exec_commit_block(consensus_conn, block, self.state_store,
+                                  state.initial_height)
+                _seg.dispatched()
+            except BaseException:
+                _seg.abandon()
+                raise
+            _seg.fetched()
             self.n_blocks += 1
         res = query_conn.info(abci.RequestInfo(version="0.1.0-tpu"))
         _assert_app_hash_eq(res.last_block_app_hash, state.app_hash)
@@ -221,7 +241,8 @@ class Handshaker:
 
         block_exec = BlockExecutor(self.state_store, consensus_conn,
                                    NoOpMempool(), EmptyEvidencePool(),
-                                   self.block_store, self.event_bus)
+                                   self.block_store, self.event_bus,
+                                   exec_config=self.exec_config)
         state, _ = block_exec.apply_block(state, meta.block_id, block)
         self.n_blocks += 1
         return state

@@ -83,6 +83,172 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     return level[0]
 
 
+# --- vectorized fast path + incremental roots (app-hash merkle) -------------
+#
+# MerkleKVStoreApplication recomputes its root every commit; at real state
+# sizes that is the execution plane's commit bottleneck. Two escapes, both
+# byte-identical to hash_from_byte_slices (differential-tested):
+#
+#  * batched hashing — one tree level's nodes are equal-length messages
+#    (inner nodes always 65 bytes), so they vectorize through
+#    crypto/merkle_fast.py: numpy on the host, jnp on the device behind
+#    the shared crypto breaker with hashlib as the always-on fallback.
+#  * IncrementalMerkle — a cached level structure patched along the paths
+#    of dirty leaves, so commit cost scales with writes, not state size.
+#
+# TMTPU_MERKLE_FAST=0 disables batching (hashlib everywhere);
+# TMTPU_MERKLE_NP_MIN / TMTPU_MERKLE_DEVICE_MIN set the minimum batch for
+# the numpy / device routes (numpy per-op overhead beats hashlib's C loop
+# only for wide levels; the device needs wider still to amortize dispatch).
+
+import os as _os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(_os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _batch_sha256(msgs: List[bytes]) -> Optional[List[bytes]]:
+    """Hash n equal-length messages, vectorized when worthwhile; None
+    tells the caller to take the hashlib loop."""
+    if (_os.environ.get("TMTPU_MERKLE_FAST", "1") == "0"
+            or len(msgs) < _env_int("TMTPU_MERKLE_NP_MIN", 1024)):
+        return None
+    try:
+        from . import merkle_fast as mf
+    except Exception:
+        return None
+    if len(msgs) >= _env_int("TMTPU_MERKLE_DEVICE_MIN", 16384):
+        from .breaker import device_breaker
+
+        if mf.device_ready() and device_breaker.allow():
+            try:
+                out = mf.sha256_many_device(msgs)
+                device_breaker.record_success()
+                return out
+            except Exception:
+                device_breaker.record_failure()
+    return mf.sha256_many_np(msgs)
+
+
+def _leaf_hashes(items: Sequence[bytes]) -> List[bytes]:
+    """Leaf level, batched per item length (one kvstore level is mostly
+    homogeneous; stragglers take the hashlib path)."""
+    out: List[Optional[bytes]] = [None] * len(items)
+    by_len: dict = {}
+    for i, item in enumerate(items):
+        by_len.setdefault(len(item), []).append(i)
+    for idxs in by_len.values():
+        hashed = _batch_sha256([LEAF_PREFIX + items[i] for i in idxs])
+        if hashed is None:
+            for i in idxs:
+                out[i] = leaf_hash(items[i])
+        else:
+            for j, i in enumerate(idxs):
+                out[i] = hashed[j]
+    return out
+
+
+def _inner_level(level: List[bytes]) -> List[bytes]:
+    """One reduction step: pair-adjacent inner hashes, odd node promoted
+    (same scheme as hash_from_byte_slices)."""
+    pairs = [INNER_PREFIX + level[2 * i] + level[2 * i + 1]
+             for i in range(len(level) // 2)]
+    nxt = _batch_sha256(pairs)
+    if nxt is None:
+        nxt = [inner_hash(level[2 * i], level[2 * i + 1])
+               for i in range(len(level) // 2)]
+    if len(level) % 2:
+        nxt.append(level[-1])
+    return nxt
+
+
+def _build_levels(items: Sequence[bytes]) -> List[List[bytes]]:
+    """All tree levels bottom-up; levels[0] = leaf hashes, levels[-1] =
+    [root]. Empty input is the caller's problem (no levels exist)."""
+    levels = [_leaf_hashes(items)]
+    while len(levels[-1]) > 1:
+        levels.append(_inner_level(levels[-1]))
+    return levels
+
+
+def fast_hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """hash_from_byte_slices through the vectorized path — byte-identical
+    root, batched level hashing when the tree is wide enough."""
+    if len(items) == 0:
+        return _sha256(b"")
+    return _build_levels(list(items))[-1][0]
+
+
+class IncrementalMerkle:
+    """Merkle root cache with dirty-leaf patching.
+
+    ``root(keys, leaf_item, dirty)`` returns the root over
+    ``[leaf_item(k) for k in keys]``. While ``keys`` is unchanged from the
+    previous call, only leaves named in ``dirty`` re-hash and only their
+    root paths recompute — O(|dirty| · log n) instead of O(n). Any key-set
+    change (insert/delete/reorder), ``dirty=None``, or a wide dirty set
+    triggers a full (vectorized) rebuild. The result is always identical
+    to ``hash_from_byte_slices`` over the same items; tests hold the two
+    in lockstep over randomized op sequences.
+    """
+
+    def __init__(self):
+        self._keys: List = []
+        self._pos: dict = {}
+        self._levels: Optional[List[List[bytes]]] = None
+        self.rebuilds = 0  # observability for tests/bench
+        self.patches = 0
+
+    def reset(self) -> None:
+        self._keys, self._pos, self._levels = [], {}, None
+
+    def root(self, keys: Sequence, leaf_item, dirty=None) -> bytes:
+        keys = list(keys)
+        if not keys:
+            self.reset()
+            return _sha256(b"")
+        stale = (self._levels is None or dirty is None
+                 or keys != self._keys)
+        # patching is hashlib-serial; past ~n/4 dirty leaves the batched
+        # full rebuild is cheaper and touches every path anyway
+        if not stale and len(dirty) >= max(32, len(keys) // 4):
+            stale = True
+        if stale:
+            self._keys = keys
+            self._pos = {k: i for i, k in enumerate(keys)}
+            self._levels = _build_levels([leaf_item(k) for k in keys])
+            self.rebuilds += 1
+            return self._levels[-1][0]
+        if dirty:
+            self.patches += 1
+            levels = self._levels
+            cur = set()
+            for k in dirty:
+                # a key created-then-deleted inside one window sits in the
+                # dirty set but is no longer a leaf; with the key set
+                # otherwise unchanged it contributes nothing to the tree
+                i = self._pos.get(k)
+                if i is None:
+                    continue
+                levels[0][i] = leaf_hash(leaf_item(k))
+                cur.add(i)
+            for lvl in range(len(levels) - 1):
+                level, nxt = levels[lvl], levels[lvl + 1]
+                parents = {i // 2 for i in cur}
+                for p in parents:
+                    li = 2 * p
+                    if li + 1 < len(level):
+                        nxt[p] = inner_hash(level[li], level[li + 1])
+                    else:
+                        nxt[p] = level[li]  # promoted odd node
+                cur = parents
+        return self._levels[-1][0]
+
+
 @dataclass
 class Proof:
     """Inclusion proof (reference crypto/merkle/proof.go:35)."""

@@ -98,6 +98,11 @@ KNOWN_SITES = frozenset({
     "wal.fsync",
     "db.write_batch",
     "net.drop",
+    # conflict-group mis-assignment (state/parallel.py): a fired trigger
+    # tosses a tx into a deliberately wrong speculation lane, forcing the
+    # validation + re-execution machinery to earn the byte-parity
+    # invariant instead of riding correct hints
+    "exec.conflict",
     # content-corruption (adversarial) sites — consulted via mutate()
     "net.corrupt",
     "statesync.lying_snapshot",

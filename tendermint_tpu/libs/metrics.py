@@ -500,6 +500,17 @@ class StateMetrics:
             "state", "block_processing_time",
             "Seconds in ApplyBlock.", buckets=(0.001, 0.005, 0.01, 0.025,
                                                0.05, 0.1, 0.25, 0.5, 1.0))
+        # optimistic parallel execution plane (state/parallel.py)
+        self.parallel_exec_blocks = reg.counter(
+            "state", "parallel_exec_blocks_total",
+            "Blocks executed via the optimistic parallel path.")
+        self.parallel_exec_conflict_txs = reg.counter(
+            "state", "parallel_exec_conflict_txs_total",
+            "Txs serially re-executed after conflict validation.")
+        self.parallel_exec_fallbacks = reg.counter(
+            "state", "parallel_exec_fallbacks_total",
+            "Blocks that fell back to the serial spec path.",
+            labels=("reason",))
 
 
 class CryptoMetrics:

@@ -135,6 +135,34 @@ def tx_sender(tx: bytes) -> str:
     return "h:" + hashlib.sha256(tx).hexdigest()[:16]
 
 
+def conflict_hint(tx: bytes) -> Tuple[str, str]:
+    """Conflict-group HINT for optimistic parallel execution
+    (state/parallel.py): txs with different hints are *presumed*
+    independent and speculated concurrently. This is only a scheduling
+    hint — correctness never depends on it, because the executor
+    validates actual read/write overlaps after speculation and
+    re-executes anything the hint got wrong.
+
+    ``("sender", pubkey_hex)`` for signed ``stx1`` envelopes (the ingest
+    plane's per-sender lanes double as execution lanes);
+    ``("key", k)`` for unsigned txs that strictly decode to the kvstore
+    ``key=value`` format; ``("barrier", "")`` for validator-update
+    ``val:`` txs and anything unparseable — those serialize in one
+    block-ordered group."""
+    status, stx = parse_signed_tx(tx)
+    if status == SIGNED:
+        return "sender", stx.pubkey.hex()
+    if status == MALFORMED:
+        return "barrier", ""
+    try:
+        raw = tx.decode("utf-8")
+    except UnicodeDecodeError:
+        return "barrier", ""
+    if raw.startswith("val:"):
+        return "barrier", ""
+    return "key", raw.split("=", 1)[0] if "=" in raw else raw
+
+
 def verify_signed_tx_scalar(tx: bytes) -> Tuple[bool, str]:
     """The SCALAR pre-verification spec the batched path must match
     byte-identically (differentially tested): (accept, reason)."""

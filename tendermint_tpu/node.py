@@ -115,7 +115,8 @@ class Node:
         if loaded is not None:
             state = loaded
         self.event_bus = EventBus()
-        handshaker = Handshaker(self.state_store, state, self.block_store, genesis)
+        handshaker = Handshaker(self.state_store, state, self.block_store,
+                                genesis, exec_config=config.execution)
         state = handshaker.handshake(self.proxy_app.consensus, self.proxy_app.query)
         self.state_store.save(state)
         self.initial_state = state
@@ -169,7 +170,8 @@ class Node:
         # -- block executor --------------------------------------------------
         self.block_exec = BlockExecutor(
             self.state_store, self.proxy_app.consensus, self.mempool,
-            self.evidence_pool, self.block_store, self.event_bus)
+            self.evidence_pool, self.block_store, self.event_bus,
+            exec_config=config.execution)
 
         # -- consensus (node.go:465) ----------------------------------------
         wal_path = config.wal_file()

@@ -76,13 +76,38 @@ class BlockExecutor:
 
     def __init__(self, state_store: StateStore, proxy_app_consensus: Client,
                  mempool: Mempool, evidence_pool: EvidencePool,
-                 block_store: Optional[BlockStore] = None, event_bus=None):
+                 block_store: Optional[BlockStore] = None, event_bus=None,
+                 exec_config=None):
         self.state_store = state_store
         self.proxy_app = proxy_app_consensus
         self.mempool = mempool
         self.evpool = evidence_pool
         self.block_store = block_store
         self.event_bus = event_bus
+        # execution.version: "v1" = optimistic parallel (state/parallel.py)
+        # with automatic serial fallback; "v0"/None = the serial spec path
+        self.exec_config = exec_config
+        self._parallel = None
+        if exec_config is not None and exec_config.version == "v1":
+            from .parallel import ParallelExecutor
+
+            self._parallel = ParallelExecutor(
+                workers=exec_config.workers,
+                min_parallel_txs=exec_config.min_parallel_txs)
+
+    def _exec_block(self, block: Block, state: State) -> ABCIResponses:
+        """The execute stage: parallel when configured AND eligible,
+        else the serial spec — outputs byte-identical either way."""
+        if self._parallel is not None:
+            if self.metrics is not None:
+                self._parallel.metrics = self.metrics
+            resp = self._parallel.try_exec_block(
+                self.proxy_app, block, self.state_store,
+                state.initial_height)
+            if resp is not None:
+                return resp
+        return exec_block_on_proxy_app(
+            self.proxy_app, block, self.state_store, state.initial_height)
 
     # -- proposal creation (execution.go:94 CreateProposalBlock) -----------
 
@@ -117,14 +142,37 @@ class BlockExecutor:
                            block: Block) -> Tuple[State, int]:
         import time as _time
 
+        from ..crypto import phases
         from ..libs.fail import fail_point
 
         _t0 = _time.perf_counter()
+        # exec-plane phase record (plane="exec", device="app"): validate
+        # maps to pack, execute to dispatch, commit+persist to fetch — so
+        # phase_breakdown() can split exposed-execute from exposed-verify
+        # wall share under the blocksync pipeline.
+        _seg = phases.Segment(sigs=len(block.data.txs),
+                              chunk=len(block.data.txs), device="app",
+                              plane="exec", height=block.header.height)
+        _seg.begin()
+        try:
+            new_state, retain = self._apply_block_phases(
+                state, block_id, block, _seg, fail_point)
+        except BaseException:
+            _seg.abandon()
+            raise
+        if self.metrics is not None:
+            self.metrics.block_processing_time.observe(
+                _time.perf_counter() - _t0)
+        return new_state, retain
+
+    def _apply_block_phases(self, state: State, block_id: BlockID,
+                            block: Block, _seg, fail_point) -> Tuple[State, int]:
         self.validate_block(state, block)
         fail_point("execution.before_exec_block")  # (execution.go:149)
+        _seg.pack_done()
 
-        abci_responses = exec_block_on_proxy_app(
-            self.proxy_app, block, self.state_store, state.initial_height)
+        abci_responses = self._exec_block(block, state)
+        _seg.dispatched()
 
         self.state_store.save_abci_responses(block.header.height, abci_responses)
 
@@ -144,14 +192,14 @@ class BlockExecutor:
 
         new_state.app_hash = app_hash
         self.state_store.save(new_state)
+        _seg.fetched()
 
         fail_point("execution.after_state_save")  # (execution.go:196)
         if self.event_bus is not None:
+            # event publication order is the ABCIResponses ordering
+            # contract: per-tx events index deliver_txs by block position
             fire_events(self.event_bus, block, block_id, abci_responses, validator_updates)
 
-        if self.metrics is not None:
-            self.metrics.block_processing_time.observe(
-                _time.perf_counter() - _t0)
         return new_state, retain_height
 
     def _commit(self, state: State, block: Block,

@@ -51,7 +51,17 @@ def _abci_responses_key(h: int) -> bytes:
 
 @dataclass
 class ABCIResponses:
-    """Responses persisted per height (reference proto/tendermint/state ABCIResponses)."""
+    """Responses persisted per height (reference proto/tendermint/state ABCIResponses).
+
+    ORDERING CONTRACT: ``deliver_txs[i]`` is the response to
+    ``block.data.txs[i]`` — block position, not execution order. Everything
+    downstream leans on the index: ``results_hash()`` merkle-hashes the
+    list positionally (committed into the next header), event publication
+    pairs ``txs[i]`` with ``deliver_txs[i]`` (execution.py fire_events),
+    the tx indexer keys on (height, i), and mempool.update consumes the
+    list zip-wise. Any executor — serial or parallel (state/parallel.py) —
+    must assemble this list by block index; tests/test_parallel_exec.py
+    pins the contract differentially."""
 
     deliver_txs: List[abci.ResponseDeliverTx] = field(default_factory=list)
     end_block: Optional[abci.ResponseEndBlock] = None

@@ -272,23 +272,33 @@ def test_byzantine_double_prevote_produces_evidence():
         for nd in nodes:
             await nd.start()
         await net.connect_all()
+        byz_addr = nodes[0].pv.get_pub_key().address()
+
+        def evidence_committed():
+            # some honest node committed the duplicate-vote evidence
+            for nd in nodes[1:]:
+                for h in range(2, nd.block_store.height() + 1):
+                    blk = nd.block_store.load_block(h)
+                    for ev in (blk.evidence if blk else []):
+                        if isinstance(ev, DuplicateVoteEvidence):
+                            assert ev.vote_a.validator_address == byz_addr
+                            return True
+            return False
+
         try:
             # enough heights for gossip to surface the conflict and for the
-            # next proposer to include the pooled evidence (timing varies)
+            # next proposer to include the pooled evidence — WHICH height
+            # that is varies with timing, so wait for the commit itself
+            # rather than racing a fixed height
             await wait_all_height(nodes, 8, timeout=90.0)
+            deadline = asyncio.get_running_loop().time() + 90.0
+            while not evidence_committed():
+                if asyncio.get_running_loop().time() > deadline:
+                    break
+                await asyncio.sleep(0.25)
         finally:
             for nd in nodes:
                 await nd.stop()
-        # some honest node committed the duplicate-vote evidence in a block
-        found_in_block = False
-        byz_addr = nodes[0].pv.get_pub_key().address()
-        for nd in nodes[1:]:
-            for h in range(2, nd.block_store.height() + 1):
-                blk = nd.block_store.load_block(h)
-                for ev in (blk.evidence if blk else []):
-                    if isinstance(ev, DuplicateVoteEvidence):
-                        assert ev.vote_a.validator_address == byz_addr
-                        found_in_block = True
-        assert found_in_block, "duplicate-vote evidence never committed"
+        assert evidence_committed(), "duplicate-vote evidence never committed"
 
     asyncio.run(run())

@@ -1,0 +1,408 @@
+"""Optimistic parallel block execution (state/parallel.py): byte-parity vs
+the serial spec, conflict-closure correctness, fallback gating, the response/
+event ordering contract, and crash recovery mid-parallel-apply.
+
+Every parity test runs the SAME block through two twin rigs — one with
+``execution.version = "v0"`` (serial spec) and one with ``"v1"`` (parallel) —
+and asserts the persisted ABCIResponses JSON, app hash, last_results_hash,
+and final app state are byte-identical.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.abci.example.kvstore import (KVStoreApplication,
+                                                 MerkleKVStoreApplication)
+from tendermint_tpu.config import ExecutionConfig
+from tendermint_tpu.libs import fail
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.libs.faults import faults
+from tendermint_tpu.mempool.ingest import conflict_hint, make_signed_tx
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis
+from tendermint_tpu.state.execution import EmptyEvidencePool, NoOpMempool
+from tendermint_tpu.state.parallel import (ParallelExecutor, SpecView, TxLog,
+                                           conflict_closure, conflict_groups)
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import (BlockID, GenesisDoc, GenesisValidator,
+                                  MockPV, SignedMsgType, Vote, VoteSet)
+from tendermint_tpu.types import events as tme
+from tendermint_tpu.types.block import Commit
+
+CHAIN_ID = "parallel-test"
+
+SENDERS = [crypto.Ed25519PrivKey.generate(bytes([i]) * 32) for i in range(1, 9)]
+VAL_KEYS = [crypto.Ed25519PrivKey.generate(bytes([100 + i]) * 32)
+            for i in range(6)]
+
+
+def _rig(version, app_cls=MerkleKVStoreApplication, workers=4,
+         min_parallel_txs=2):
+    pv = MockPV(crypto.Ed25519PrivKey.generate(b"\x11" * 32))
+    genesis = GenesisDoc(chain_id=CHAIN_ID,
+                         genesis_time_ns=1_700_000_000_000_000_000,
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    state = state_from_genesis(genesis)
+    app = app_cls()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    ss = StateStore(MemDB())
+    ss.save(state)
+    ex = BlockExecutor(ss, conns.consensus, NoOpMempool(),
+                       EmptyEvidencePool(), BlockStore(MemDB()),
+                       exec_config=ExecutionConfig(
+                           version=version, workers=workers,
+                           min_parallel_txs=min_parallel_txs))
+    return pv, state, ex, ss, app
+
+
+def _apply_one(version, txs, app_cls=MerkleKVStoreApplication):
+    """Apply one block of `txs` at height 1; return the parity tuple."""
+    pv, state, ex, ss, app = _rig(version, app_cls)
+    proposer = state.validators.get_proposer().address
+    block, parts = state.make_block(1, txs, Commit(0, 0, BlockID(), []),
+                                    [], proposer)
+    bid = BlockID(block.hash(), parts.header())
+    state, _ = ex.apply_block(state, bid, block)
+    return (ss.load_abci_responses(1).to_json(), state.app_hash,
+            state.last_results_hash, dict(app.state), app.tx_count,
+            dict(app.validators)), ex
+
+
+def assert_parity(txs, app_cls=MerkleKVStoreApplication):
+    serial, _ = _apply_one("v0", txs, app_cls)
+    parallel, ex = _apply_one("v1", txs, app_cls)
+    assert serial == parallel
+    return ex._parallel
+
+
+# -- differential suite ------------------------------------------------------
+
+
+def test_parity_disjoint_senders():
+    txs = [make_signed_tx(SENDERS[i % 8], f"s{i}=v{i}".encode(), nonce=i)
+           for i in range(32)]
+    p = assert_parity(txs)
+    assert p.last_groups == 8
+    assert p.last_conflicted == 0
+
+
+def test_parity_same_key_conflict_storm():
+    # every tx writes the same key: one giant group, strictly serial order
+    txs = [f"hot=v{i}".encode() for i in range(40)]
+    p = assert_parity(txs)
+    assert p.last_groups == 1
+
+
+def test_parity_val_txs_crossing_groups():
+    # validator updates interleaved with kv writes; each val pubkey distinct
+    # (duplicate addresses in one block are rejected by update validation)
+    txs = []
+    for i, vk in enumerate(VAL_KEYS[:4]):
+        txs.append(f"val:{vk.pub_key().bytes().hex()}!{i + 1}".encode())
+        txs.append(f"k{i}=x".encode())
+        txs.append(make_signed_tx(SENDERS[i], f"w{i}=y".encode(), nonce=i))
+    assert_parity(txs)
+
+
+def test_parity_unparseable_barrier_groups():
+    txs = [b"a=1", bytes([0xff, 0xfe, 1]), b"b=2", b"val:zznothex!5",
+           b"c=3", bytes(6), b"noequals", b"d=4"]
+    assert_parity(txs)
+
+
+def test_parity_mixed_seeded_workload():
+    import random
+    rng = random.Random(3)
+    vals = iter(VAL_KEYS)
+    txs = []
+    for i in range(50):
+        r = rng.random()
+        if r < 0.4:
+            sk = SENDERS[rng.randrange(8)]
+            txs.append(make_signed_tx(sk, f"s{i}=v{rng.random()}".encode(),
+                                      nonce=i))
+        elif r < 0.7:
+            txs.append(f"shared{rng.randrange(5)}=x{i}".encode())
+        elif r < 0.76:
+            try:
+                pk = next(vals).pub_key()
+                txs.append(f"val:{pk.bytes().hex()}!{rng.randrange(1, 20)}"
+                           .encode())
+            except StopIteration:
+                txs.append(f"v{i}=z".encode())
+        elif r < 0.9:
+            txs.append(bytes([rng.randrange(256) for _ in range(12)]))
+        else:
+            txs.append(b"val:zznothex!5")
+    rng.shuffle(txs)
+    assert_parity(txs)
+
+
+def test_parity_exec_conflict_fault_forces_reexec():
+    """exec.conflict mis-assigns txs to chaos lanes; validation + serial
+    re-exec must still land on the exact serial bytes."""
+    txs = [f"val:{VAL_KEYS[0].pub_key().bytes().hex()}!7".encode(), b"q=1",
+           make_signed_tx(SENDERS[0], b"w=2", nonce=0),
+           b"val:zznothex!5", bytes([250, 251, 1]),
+           f"val:{VAL_KEYS[1].pub_key().bytes().hex()}!9".encode(), b"q=2"]
+    serial, _ = _apply_one("v0", txs)
+    faults.configure("exec.conflict", seed=5)
+    try:
+        parallel, ex = _apply_one("v1", txs)
+    finally:
+        faults.reset()
+    assert serial == parallel
+    assert ex._parallel.last_conflicted > 0  # the fault actually bit
+
+
+def test_parity_plain_kvstore_app():
+    # the non-merkle kvstore takes the same speculation protocol
+    txs = [f"k{i % 5}=v{i}".encode() for i in range(20)]
+    assert_parity(txs, app_cls=KVStoreApplication)
+
+
+def test_parity_multi_height():
+    """3 heights through both rigs; app hash chains forward identically."""
+    outs = {}
+    for version in ("v0", "v1"):
+        pv, state, ex, ss, app = _rig(version)
+        last_commit = Commit(0, 0, BlockID(), [])
+        for h in range(1, 4):
+            proposer = state.validators.get_proposer().address
+            txs = ([f"h{h}k{i % 3}=v{i}".encode() for i in range(8)]
+                   + [make_signed_tx(SENDERS[i], f"sh{h}={i}".encode(),
+                                     nonce=h * 10 + i) for i in range(4)])
+            block, parts = state.make_block(h, txs, last_commit, [], proposer)
+            bid = BlockID(block.hash(), parts.header())
+            state, _ = ex.apply_block(state, bid, block)
+            vs = VoteSet(state.chain_id, h, 0, SignedMsgType.PRECOMMIT,
+                         state.validators)
+            v = Vote(SignedMsgType.PRECOMMIT, h, 0, bid,
+                     block.header.time_ns + 1,
+                     state.validators.validators[0].address, 0)
+            pv.sign_vote(state.chain_id, v)
+            vs.add_vote(v)
+            last_commit = vs.make_commit()
+        outs[version] = (state.app_hash, state.last_results_hash,
+                         dict(app.state), app.tx_count,
+                         [ss.load_abci_responses(h).to_json()
+                          for h in range(1, 4)])
+    assert outs["v0"] == outs["v1"]
+
+
+# -- conflict machinery units ------------------------------------------------
+
+
+def test_conflict_hint_classes():
+    sk = SENDERS[0]
+    assert conflict_hint(make_signed_tx(sk, b"a=1", nonce=0)) == \
+        ("sender", sk.pub_key().bytes().hex())
+    assert conflict_hint(b"a=1") == ("key", "a")
+    assert conflict_hint(b"noequals") == ("key", "noequals")
+    assert conflict_hint(bytes([0xff, 0xfe])) == ("barrier", "")
+    assert conflict_hint(b"val:aa!1") == ("barrier", "")
+
+
+def test_conflict_groups_preserve_block_order():
+    txs = [b"a=1", b"b=1", b"a=2", b"c=1", b"b=2"]
+    assert conflict_groups(txs) == [[0, 2], [1, 4], [3]]
+
+
+def _log(idx, keys):
+    log = TxLog(idx)
+    log.keys = set(keys)
+    return log
+
+
+def test_conflict_closure_fixpoint():
+    # key a is cross-group -> every a-toucher conflicts; their OTHER keys
+    # (b via tx 2, c via tx 3) join the closure and drag tx 1 in too;
+    # group 2's private key d stays clean
+    logs = [_log(0, {("kv", "a")}),
+            _log(1, {("kv", "b")}),
+            _log(2, {("kv", "a"), ("kv", "b")}),
+            _log(3, {("kv", "a"), ("kv", "c")}),
+            _log(4, {("kv", "d")})]
+    group_of = {0: 0, 1: 1, 2: 1, 3: 0, 4: 2}
+    ct, ck = conflict_closure(logs, group_of)
+    assert ct == {0, 1, 2, 3}
+    assert {("kv", "a"), ("kv", "b"), ("kv", "c")} <= ck
+    assert 4 not in ct and ("kv", "d") not in ck
+
+
+def test_spec_view_read_through_and_overlay():
+    class FakeApp:
+        def spec_read(self, space, key):
+            return "base" if (space, key) == ("kv", "a") else None
+
+    view = SpecView(FakeApp())
+    view.begin_tx(0)
+    assert view.read("kv", "a") == "base"
+    view.write("kv", "a", "new")
+    assert view.read("kv", "a") == "new"
+    assert ("kv", "a") in view.logs[0].keys
+    assert ("set", "kv", "a", "new", None) in view.logs[0].ops
+
+
+# -- fallback gating ---------------------------------------------------------
+
+
+def test_small_block_falls_back_to_serial():
+    pv, state, ex, ss, app = _rig("v1", min_parallel_txs=10)
+    proposer = state.validators.get_proposer().address
+    block, parts = state.make_block(1, [b"a=1", b"b=2"],
+                                    Commit(0, 0, BlockID(), []), [], proposer)
+    bid = BlockID(block.hash(), parts.header())
+    state, _ = ex.apply_block(state, bid, block)
+    assert app.state == {"a": "1", "b": "2"}
+    assert ex._parallel.last_groups == 0  # never speculated
+
+
+def test_unsupported_app_falls_back():
+    class NoSpecApp(Application):
+        """parallel_exec_supported stays False."""
+
+        def __init__(self):
+            self.seen = []
+
+        def deliver_tx(self, req):
+            self.seen.append(req.tx)
+            return abci.ResponseDeliverTx(code=0)
+
+    pv, state, ex, ss, app = _rig("v1", app_cls=NoSpecApp)
+    proposer = state.validators.get_proposer().address
+    txs = [f"t{i}".encode() for i in range(8)]
+    block, parts = state.make_block(1, txs, Commit(0, 0, BlockID(), []),
+                                    [], proposer)
+    bid = BlockID(block.hash(), parts.header())
+    state, _ = ex.apply_block(state, bid, block)
+    assert app.seen == txs  # serial path ran, in order
+
+
+def test_v0_never_builds_parallel_executor():
+    _, _, ex, _, _ = _rig("v0")
+    assert ex._parallel is None
+
+
+# -- ordering contract (state/store.py ABCIResponses) ------------------------
+
+
+def test_response_ordering_contract():
+    """deliver_txs[i] answers block.data.txs[i], and EventDataTx fires in
+    index order — under parallel execution with cross-group conflicts."""
+    txs = [f"k{i % 3}=v{i}".encode() for i in range(12)]  # 3 colliding lanes
+    pv, state, ex, ss, app = _rig("v1")
+    from tendermint_tpu.types.event_bus import EventBus, EventDataTx
+    bus = EventBus()
+    ex.event_bus = bus
+    sub = bus.subscribe("order-test", tme.QUERY_TX)
+    proposer = state.validators.get_proposer().address
+    block, parts = state.make_block(1, txs, Commit(0, 0, BlockID(), []),
+                                    [], proposer)
+    bid = BlockID(block.hash(), parts.header())
+    state, _ = ex.apply_block(state, bid, block)
+
+    resp = ss.load_abci_responses(1)
+    assert len(resp.deliver_txs) == len(txs)
+    for i, r in enumerate(resp.deliver_txs):
+        # kvstore tags each response with the tx's own key attribute
+        attrs = {a.key: a.value for ev in r.events for a in ev.attributes}
+        assert attrs[b"key"] == txs[i].split(b"=", 1)[0]
+
+    seen = []
+    while not sub.queue.empty():
+        msg = sub.queue.get_nowait()
+        if isinstance(msg.data, EventDataTx):
+            seen.append((msg.data.index, msg.data.tx))
+    assert seen == [(i, tx) for i, tx in enumerate(txs)]
+
+
+# -- proxy lock split --------------------------------------------------------
+
+
+def test_query_does_not_block_on_consensus_apply():
+    """A query on the query connection completes while a slow deliver_tx
+    holds the consensus (writer) lock."""
+    gate = threading.Event()
+
+    class SlowApp(KVStoreApplication):
+        parallel_exec_supported = False  # force the serial locked path
+
+        def deliver_tx(self, req):
+            gate.wait(timeout=5.0)
+            return super().deliver_tx(req)
+
+    app = SlowApp()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    app.state["probe"] = "1"
+
+    done = threading.Event()
+
+    def writer():
+        conns.consensus.deliver_tx(abci.RequestDeliverTx(tx=b"x=1"))
+        done.set()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    time.sleep(0.05)  # writer is now parked inside deliver_tx
+    t0 = time.monotonic()
+    res = conns.query.query(abci.RequestQuery(data=b"probe", path="/store"))
+    elapsed = time.monotonic() - t0
+    gate.set()
+    t.join(timeout=5.0)
+    assert done.is_set()
+    assert res.value == b"1"
+    assert elapsed < 1.0  # returned while the writer still held its lock
+
+
+def test_zero_arg_creator_still_works():
+    app = KVStoreApplication()
+    calls = []
+
+    def creator():
+        from tendermint_tpu.abci.client import LocalClient
+        calls.append(1)
+        return LocalClient(app, threading.RLock())
+
+    conns = AppConns(creator)
+    conns.start()
+    assert len(calls) == 4
+    assert conns.query.echo("hi") == "hi"
+
+
+# -- crash mid-parallel-apply ------------------------------------------------
+
+
+def test_crash_at_before_exec_block_parallel_replays_identically():
+    """Kill at execution.before_exec_block under v1, then recover: replaying
+    the same block lands on the exact bytes the serial spec produces."""
+    txs = [f"k{i % 4}=v{i}".encode() for i in range(16)]
+    serial, _ = _apply_one("v0", txs)
+
+    pv, state, ex, ss, app = _rig("v1")
+    proposer = state.validators.get_proposer().address
+    block, parts = state.make_block(1, txs, Commit(0, 0, BlockID(), []),
+                                    [], proposer)
+    bid = BlockID(block.hash(), parts.header())
+    fail.arm_raise("execution.before_exec_block")
+    with pytest.raises(fail.KilledAtFailPoint):
+        ex.apply_block(state, bid, block)
+    assert fail.killed_at() == "execution.before_exec_block"
+    # nothing durable happened: no responses, app untouched
+    assert ss.load_abci_responses(1) is None
+    assert app.tx_count == 0
+
+    # recovery: a fresh executor (same stores/app — the kill fired before
+    # any app mutation) replays the block to the exact serial bytes
+    state2, _ = ex.apply_block(state, bid, block)
+    got = (ss.load_abci_responses(1).to_json(), state2.app_hash,
+           state2.last_results_hash, dict(app.state), app.tx_count,
+           dict(app.validators))
+    assert got == serial

@@ -459,6 +459,30 @@ def self_test() -> int:
         # ...and a loosened per-metric threshold un-trips the regression
         assert main(["--threshold", "inproc_crash4_kill_caughtup_s=9",
                      cr_base, cr_bad]) == 0
+        # the exec A/B row gates higher-better in BOTH directions: a
+        # committed-throughput collapse regresses, a jump reads improved
+        ex_base = os.path.join(d, "exec_base.json")
+        _write(ex_base, {"inproc_exec4_committed_txs_per_sec":
+                         (100.0, "txs/s")})
+        ex_bad = os.path.join(d, "exec_bad.json")
+        _write(ex_bad, {"inproc_exec4_committed_txs_per_sec":
+                        (40.0, "txs/s")})
+        assert main([ex_base, ex_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ex_base), load_bench(ex_bad), {})}
+        assert rows["inproc_exec4_committed_txs_per_sec"][
+            "status"] == "regressed"
+        ex_fast = os.path.join(d, "exec_fast.json")
+        _write(ex_fast, {"inproc_exec4_committed_txs_per_sec":
+                         (250.0, "txs/s")})
+        assert main([ex_base, ex_fast]) == 0
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ex_base), load_bench(ex_fast), {})}
+        assert rows["inproc_exec4_committed_txs_per_sec"][
+            "status"] == "improved"
+        # ...while the exec phase breakdown stays informational
+        assert gate_direction("inproc_exec4_phase_breakdown",
+                              "ratio") is None
         # the driver's record format ({"tail": jsonl}) parses identically
         drv = os.path.join(d, "driver.json")
         with open(drv, "w") as f:

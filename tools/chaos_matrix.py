@@ -66,6 +66,15 @@ Churn cells — membership change as the fault (tools/churn.py rig):
   sparse net survives capped bit flips on in-flight payloads (receivers
   drop corrupting links, the redial loop re-heals), hashes identical
 
+Execution cells — the parallel-execution plane (state/parallel.py):
+
+* exec.conflict_storm — every tx of every block writes the SAME key while
+  the ``exec.conflict`` site scrambles conflict-lane assignments: the
+  worst case for optimistic execution (everything conflicts, speculation
+  buys nothing, validation + serial re-execution must carry the whole
+  block). Commits must stay byte-identical to the serial spec — responses,
+  app hash, results hash — across 3 heights
+
 Crash cells — process death as the fault (tools/crashmatrix.py plane):
 
 * crash.torn_wal — seeded torn WAL appends (``wal.torn_write``): replay
@@ -124,6 +133,8 @@ SITES = {
     "churn.rotate": True,
     "churn.partition32": True,
     "churn.corrupt32": True,
+    # execution cells (the parallel-execution plane; state/parallel.py)
+    "exec.conflict_storm": False,
     # crash cells (process death as the fault; tools/crashmatrix.py plane)
     "crash.torn_wal": False,
     "crash.privval": False,
@@ -1073,6 +1084,81 @@ def cell_crash_loop(seed: int) -> None:
     assert doc["crashloop"]["history"][-1]["action"] == "give-up"
 
 
+def cell_exec_conflict_storm(seed: int) -> None:
+    """All-same-key blocks under parallel execution with the
+    exec.conflict chaos site scrambling lane assignments: the serial and
+    parallel executors must commit byte-identical results at every
+    height."""
+    from tendermint_tpu import crypto
+    from tendermint_tpu.abci.example.kvstore import MerkleKVStoreApplication
+    from tendermint_tpu.config import ExecutionConfig
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+    from tendermint_tpu.state import (BlockExecutor, StateStore,
+                                      state_from_genesis)
+    from tendermint_tpu.state.execution import (EmptyEvidencePool,
+                                                NoOpMempool)
+    from tendermint_tpu.store import BlockStore
+    from tendermint_tpu.types import (BlockID, GenesisDoc, GenesisValidator,
+                                      MockPV, SignedMsgType, Vote, VoteSet)
+    from tendermint_tpu.types.block import Commit
+
+    import random
+
+    def run(version, arm):
+        if arm:
+            faults.configure("exec.conflict", seed=seed)
+        try:
+            pv = MockPV(crypto.Ed25519PrivKey.generate(b"\x21" * 32))
+            genesis = GenesisDoc(
+                chain_id=f"storm-{seed}",
+                genesis_time_ns=1_700_000_000_000_000_000,
+                validators=[GenesisValidator(pv.get_pub_key(), 10)])
+            state = state_from_genesis(genesis)
+            app = MerkleKVStoreApplication()
+            conns = AppConns(local_client_creator(app))
+            conns.start()
+            ss = StateStore(MemDB())
+            ss.save(state)
+            ex = BlockExecutor(ss, conns.consensus, NoOpMempool(),
+                               EmptyEvidencePool(), BlockStore(MemDB()),
+                               exec_config=ExecutionConfig(version=version))
+            wl_rng = random.Random(seed)  # identical workload both runs
+            last_commit = Commit(0, 0, BlockID(), [])
+            out = []
+            for h in range(1, 4):
+                txs = [b"storm=%d.%d.%08x" % (h, i, wl_rng.getrandbits(32))
+                       for i in range(30)]
+                proposer = state.validators.get_proposer().address
+                block, parts = state.make_block(h, txs, last_commit, [],
+                                                proposer)
+                bid = BlockID(block.hash(), parts.header())
+                state, _ = ex.apply_block(state, bid, block)
+                vs = VoteSet(state.chain_id, h, 0, SignedMsgType.PRECOMMIT,
+                             state.validators)
+                v = Vote(SignedMsgType.PRECOMMIT, h, 0, bid,
+                         block.header.time_ns + 1,
+                         state.validators.validators[0].address, 0)
+                pv.sign_vote(state.chain_id, v)
+                vs.add_vote(v)
+                last_commit = vs.make_commit()
+                out.append((ss.load_abci_responses(h).to_json(),
+                            state.app_hash, state.last_results_hash))
+            # storm property: the whole block is ONE conflict group (or,
+            # with the chaos site scrambling, re-executed serially)
+            if version == "v1":
+                assert ex._parallel.last_groups >= 1
+            return out, dict(app.state), app.tx_count
+        finally:
+            if arm:
+                faults.reset()
+
+    serial = run("v0", arm=False)
+    parallel = run("v1", arm=True)
+    assert serial == parallel, "conflict storm diverged from serial spec"
+
+
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
     "device.lane": cell_device_lane,
@@ -1091,6 +1177,7 @@ CELLS = {
     "churn.rotate": cell_churn_rotate,
     "churn.partition32": cell_churn_partition32,
     "churn.corrupt32": cell_churn_corrupt32,
+    "exec.conflict_storm": cell_exec_conflict_storm,
     "crash.torn_wal": cell_crash_torn_wal,
     "crash.privval": cell_crash_privval,
     "crash.loop": cell_crash_loop,

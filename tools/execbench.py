@@ -1,0 +1,288 @@
+"""execbench: serial-vs-parallel block execution A/B on an in-proc
+4-validator fleet under open-loop firehose load.
+
+The rig runs the SAME pre-planned workload twice — once with
+``execution.version = "v0"`` (the serial DeliverTx spec) and once with
+``"v1"`` (state/parallel.py optimistic parallel execution) — and reports
+committed txs/sec for each. The payload is built so execution dominates
+block time: large values (sha256 of a >2 KiB value releases the GIL, so
+speculative workers hash in real parallel) across disjoint keys (every tx
+its own conflict group — maximum speculation, zero re-execution). On a
+multi-core host the serial run visibly saturates first; on a 1-core host
+the two rates converge (ParallelExecutor caps its workers at the core
+count) and the report says so via ``n_cpus``.
+
+Load discipline is tools/loadtime.py's: send times pre-planned on a fixed
+rate grid (coordinated omission can't hide stalls), fired into the
+validators' mempools round-robin; the run measures first-send →
+everything-committed wall time at node 0.
+
+    python tools/execbench.py --self-test
+    python tools/execbench.py --seed 1 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_VALIDATORS = 4
+DEFAULT_TXS = 360
+DEFAULT_VALUE_SIZE = 4096
+DEFAULT_RATE = 4000.0
+
+_RIG = None
+
+
+def _rig():
+    """Import-heavy fleet pieces, built lazily and memoized."""
+    global _RIG
+    if _RIG is not None:
+        return _RIG
+
+    from tendermint_tpu import crypto
+    from tendermint_tpu.abci.example.kvstore import MerkleKVStoreApplication
+    from tendermint_tpu.config import ExecutionConfig
+    from tendermint_tpu.consensus import ConsensusState
+    from tendermint_tpu.consensus.config import test_consensus_config
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.consensus.replay import Handshaker
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.mempool import CListMempool
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+    from tendermint_tpu.p2p import Switch
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+    from tendermint_tpu.state import StateStore, state_from_genesis
+    from tendermint_tpu.state.execution import (BlockExecutor,
+                                                EmptyEvidencePool)
+    from tendermint_tpu.store import BlockStore
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, MockPV
+
+    class ExecNode:
+        """One in-proc validator: merkle kvstore app + consensus + mempool
+        reactors, BlockExecutor wired to the A/B's execution config."""
+
+        def __init__(self, idx, pv, genesis, exec_config):
+            self.idx = idx
+            self.pv = pv
+            self.app = MerkleKVStoreApplication()
+            self.conns = AppConns(local_client_creator(self.app))
+            self.conns.start()
+            self.state_store = StateStore(MemDB())
+            self.block_store = BlockStore(MemDB())
+            state = state_from_genesis(genesis)
+            state = Handshaker(
+                self.state_store, state, self.block_store, genesis,
+                exec_config=exec_config).handshake(self.conns.consensus,
+                                                   self.conns.query)
+            self.state_store.save(state)
+            self.mempool = CListMempool(self.conns.mempool,
+                                        max_txs_bytes=1 << 30)
+            self.block_exec = BlockExecutor(
+                self.state_store, self.conns.consensus, self.mempool,
+                EmptyEvidencePool(), self.block_store,
+                exec_config=exec_config)
+            self.cs = ConsensusState(test_consensus_config(), state,
+                                     self.block_exec, self.block_store)
+            self.cs.set_priv_validator(pv)
+            self.mempool.tx_available_callbacks.append(
+                self.cs.notify_txs_available)
+            self.switch = Switch(f"exec{idx}")
+            self.cs_reactor = ConsensusReactor(self.cs)
+            self.switch.add_reactor("CONSENSUS", self.cs_reactor)
+            self.mp_reactor = MempoolReactor(self.mempool,
+                                             gossip_sleep=0.005)
+            self.switch.add_reactor("MEMPOOL", self.mp_reactor)
+
+        async def start(self):
+            await self.switch.start()
+            await self.cs.start()
+
+        async def stop(self):
+            await self.cs.stop()
+            await self.switch.stop()
+
+    def make_fleet(exec_config, seed):
+        pvs = [MockPV(crypto.Ed25519PrivKey.generate(bytes([0x30 + i]) * 32))
+               for i in range(N_VALIDATORS)]
+        genesis = GenesisDoc(
+            chain_id=f"execbench-{seed}",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)
+                        for pv in pvs])
+        return [ExecNode(i, pv, genesis, exec_config)
+                for i, pv in enumerate(pvs)]
+
+    _RIG = {"ExecNode": ExecNode, "make_fleet": make_fleet,
+            "ExecutionConfig": ExecutionConfig}
+    return _RIG
+
+
+def make_workload(seed: int, n_txs: int, value_size: int):
+    """Disjoint-key large-value txs: every tx its own conflict group, and
+    sha256 of the value is big enough to release the GIL during
+    speculation. Deterministic in (seed, n_txs, value_size)."""
+    import random
+
+    rng = random.Random(seed)
+    unit = value_size // 8 or 1
+    return [b"e%d.%06d=" % (seed, i)
+            + (b"%08x" % rng.getrandbits(32)) * unit
+            for i in range(n_txs)]
+
+
+async def _run_fleet(version: str, seed: int, n_txs: int, value_size: int,
+                     rate: float, timeout_s: float) -> dict:
+    import asyncio
+
+    rig = _rig()
+    exec_config = rig["ExecutionConfig"](version=version)
+    nodes = rig["make_fleet"](exec_config, seed)
+
+    from tendermint_tpu.p2p import InProcNetwork
+
+    net = InProcNetwork()
+    for nd in nodes:
+        net.add_switch(nd.switch)
+    for nd in nodes:
+        await nd.start()
+    await net.connect_all()
+
+    txs = make_workload(seed, n_txs, value_size)
+    try:
+        # let the net reach steady state before the firehose opens
+        deadline = time.monotonic() + timeout_s
+        while min(nd.cs.state.last_block_height for nd in nodes) < 1:
+            if time.monotonic() > deadline:
+                raise TimeoutError("fleet never reached height 1")
+            await asyncio.sleep(0.05)
+
+        loop = asyncio.get_running_loop()
+        wall_t0 = time.perf_counter()
+        t0 = loop.time() + 0.05
+        pending = list(txs)
+        i = 0
+        while pending:
+            target = t0 + i / rate
+            now = loop.time()
+            if target > now:
+                await asyncio.sleep(target - now)
+            tx = pending[0]
+            try:
+                nodes[i % N_VALIDATORS].mempool.check_tx(tx)
+                pending.pop(0)
+            except Exception:
+                await asyncio.sleep(0.01)  # mempool full: retry the same tx
+            i += 1
+
+        # drain: every workload tx committed at node 0
+        app0 = nodes[0].app
+        while app0.tx_count < n_txs:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {app0.tx_count}/{n_txs} txs committed")
+            await asyncio.sleep(0.02)
+        wall_t1 = time.perf_counter()
+    finally:
+        for nd in nodes:
+            await nd.stop()
+
+    # exec-plane phase decomposition over the measured window (the
+    # per-block plane="exec" segments state/execution.py records)
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+
+    breakdown = BlockchainReactor.exec_phase_breakdown(wall_t0, wall_t1)
+    elapsed = wall_t1 - wall_t0
+    heights = [nd.cs.state.last_block_height for nd in nodes]
+    hashes = {nd.state_store.load().app_hash for nd in nodes}
+    assert len(hashes) == 1, "fleet diverged on app hash"
+    stats = {"groups": 0, "conflicted": 0}
+    for nd in nodes:
+        p = nd.block_exec._parallel
+        if p is not None:
+            stats["groups"] = max(stats["groups"], p.last_groups)
+            stats["conflicted"] += p.last_conflicted
+    return {
+        "version": version,
+        "txs_per_sec": n_txs / elapsed,
+        "elapsed_s": elapsed,
+        "committed": int(nodes[0].app.tx_count),
+        "heights": heights,
+        "app_hash": hashes.pop().hex(),
+        "exec_phase": {k: round(v, 4) for k, v in breakdown.items()},
+        "parallel": stats,
+    }
+
+
+def run_exec_ab(seed: int = 1, n_txs: int = DEFAULT_TXS,
+                value_size: int = DEFAULT_VALUE_SIZE,
+                rate: float = DEFAULT_RATE,
+                timeout_s: float = 180.0) -> dict:
+    """The A/B: same seed/workload, serial then parallel. Returns both
+    runs plus the speedup; both fleets must land on the same app hash
+    (the byte-parity invariant observed end-to-end)."""
+    import asyncio
+
+    from tendermint_tpu.crypto import phases
+
+    runs = {}
+    for version in ("v0", "v1"):
+        phases.reset()  # each run's exec segments decompose its own window
+        runs[version] = asyncio.run(_run_fleet(
+            version, seed, n_txs, value_size, rate, timeout_s))
+    assert runs["v0"]["app_hash"] == runs["v1"]["app_hash"], \
+        "serial and parallel fleets diverged"
+    return {
+        "seed": seed, "n_txs": n_txs, "value_size": value_size,
+        "rate": rate, "n_cpus": os.cpu_count() or 1,
+        "serial": runs["v0"], "parallel": runs["v1"],
+        "speedup": runs["v1"]["txs_per_sec"] / runs["v0"]["txs_per_sec"],
+    }
+
+
+def self_test() -> int:
+    rep = run_exec_ab(seed=1, n_txs=40, value_size=512, rate=2000.0,
+                      timeout_s=120.0)
+    assert rep["serial"]["committed"] == 40
+    assert rep["parallel"]["committed"] == 40
+    assert rep["serial"]["txs_per_sec"] > 0
+    assert rep["parallel"]["txs_per_sec"] > 0
+    assert rep["serial"]["app_hash"] == rep["parallel"]["app_hash"]
+    assert rep["parallel"]["parallel"]["groups"] > 0  # v1 really speculated
+    assert "accounted_share" in rep["parallel"]["exec_phase"]
+    print("execbench self-test: OK "
+          f"(speedup={rep['speedup']:.2f} on {rep['n_cpus']} cpu)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--self-test", action="store_true")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--txs", type=int, default=DEFAULT_TXS)
+    ap.add_argument("--value-size", type=int, default=DEFAULT_VALUE_SIZE)
+    ap.add_argument("--rate", type=float, default=DEFAULT_RATE)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    rep = run_exec_ab(seed=args.seed, n_txs=args.txs,
+                      value_size=args.value_size, rate=args.rate)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(f"serial   : {rep['serial']['txs_per_sec']:,.0f} txs/s")
+        print(f"parallel : {rep['parallel']['txs_per_sec']:,.0f} txs/s")
+        print(f"speedup  : {rep['speedup']:.2f}x on {rep['n_cpus']} cpu")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
