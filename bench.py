@@ -1312,6 +1312,124 @@ def bench_exec():
               error=f"{type(e).__name__}: {e}")
 
 
+def _mk_ed25519_commit_local(n_vals: int, chain_id: str, height: int = 100):
+    """Ed25519 validator set + fully-signed commit built with the package's
+    own keys (the aggsig A/B must run on hosts without OpenSSL bindings)."""
+    import hashlib
+
+    from tendermint_tpu import crypto
+    from tendermint_tpu.types import Validator, ValidatorSet
+    from tendermint_tpu.types.basic import (
+        BlockID,
+        BlockIDFlag,
+        PartSetHeader,
+        SignedMsgType,
+    )
+    from tendermint_tpu.types.block import Commit, CommitSig
+    from tendermint_tpu.types.canonical import vote_sign_bytes
+
+    privs = [crypto.Ed25519PrivKey.generate(
+        hashlib.sha256(f"aggsig-ed-{chain_id}-{i}".encode()).digest())
+        for i in range(n_vals)]
+    vs = ValidatorSet([Validator(p.pub_key().address(), p.pub_key(), 10)
+                       for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    sigs = []
+    for i, v in enumerate(vs.validators):
+        ts = 1_700_000_000_000_000_000 + i
+        msg = vote_sign_bytes(chain_id, SignedMsgType.PRECOMMIT, height, 0,
+                              bid, ts)
+        sigs.append(CommitSig(BlockIDFlag.COMMIT, v.address, ts,
+                              by_addr[v.address].sign(msg)))
+    return vs, Commit(height, 0, bid, sigs), bid
+
+
+def _mk_bls_aggregated_commit(n_vals: int, chain_id: str, height: int = 100):
+    """BLS validator set + one aggregated commit on a registered
+    aggregate-commits chain: every validator signs the SAME zero-timestamp
+    precommit payload; the signatures fold into one 48-byte G1 point."""
+    import hashlib
+
+    from tendermint_tpu import crypto
+    from tendermint_tpu.crypto import bls12381 as bls
+    from tendermint_tpu.crypto import schemes
+    from tendermint_tpu.libs.bits import BitArray
+    from tendermint_tpu.types import Validator, ValidatorSet
+    from tendermint_tpu.types.basic import (
+        BlockID,
+        PartSetHeader,
+        SignedMsgType,
+    )
+    from tendermint_tpu.types.block import AggregatedCommit
+    from tendermint_tpu.types.canonical import vote_sign_bytes
+    from tendermint_tpu.types.params import SignatureParams
+
+    schemes.register_chain(chain_id, SignatureParams("bls12381", True))
+    privs = [crypto.Bls12381PrivKey.generate(
+        hashlib.sha256(f"aggsig-bls-{chain_id}-{i}".encode()).digest())
+        for i in range(n_vals)]
+    vs = ValidatorSet([Validator(p.pub_key().address(), p.pub_key(), 10)
+                       for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    msg = vote_sign_bytes(chain_id, SignedMsgType.PRECOMMIT, height, 0,
+                          bid, schemes.AGG_ZERO_TS_NS)
+    agg = bls.aggregate([by_addr[v.address].sign(msg)
+                         for v in vs.validators])
+    signers = BitArray(n_vals)
+    for i in range(n_vals):
+        signers.set_index(i, True)
+    commit = AggregatedCommit(height, 0, bid, [], signers=signers,
+                              agg_sig=agg,
+                              timestamp_ns=1_700_000_000_000_000_000)
+    return vs, commit, bid
+
+
+def bench_aggsig():
+    """Config aggsig: commit verification A/B — ed25519 CommitSig lists
+    through the batched verifier vs ONE BLS fast-aggregate-verify pairing —
+    at 150 and 1000 validators, plus the informational commit-size row.
+    Steady-state regime on both sides: the same commit re-verified (warm
+    sign-bytes memo for ed25519, warm decompression/apk caches for BLS),
+    which is what the consensus hot loop and light client replay pay per
+    height once a validator set is live.  vs_baseline on the BLS rows is
+    the A/B ratio against the ed25519-batched rate at the same scale."""
+    from tendermint_tpu.crypto import schemes
+
+    sizes = {}
+    try:
+        for n_vals in (150, 1000):
+            ed_chain = f"aggsig-ed-{n_vals}"
+            vs_ed, commit_ed, bid_ed = _mk_ed25519_commit_local(
+                n_vals, ed_chain)
+            best_ed = _timed(lambda: vs_ed.verify_commit(
+                ed_chain, bid_ed, 100, commit_ed), warm=2, runs=3)
+            ed_rate = 1.0 / best_ed
+            _emit(f"verify_commit_{n_vals}val_ed25519_batched_commits_per_sec",
+                  ed_rate, "commits/s", 1.0, n_vals=n_vals)
+
+            bls_chain = f"aggsig-bls-{n_vals}"
+            vs_bls, commit_bls, bid_bls = _mk_bls_aggregated_commit(
+                n_vals, bls_chain)
+            best_bls = _timed(lambda: vs_bls.verify_commit(
+                bls_chain, bid_bls, 100, commit_bls), warm=2, runs=3)
+            bls_rate = 1.0 / best_bls
+            _emit(f"verify_commit_{n_vals}val_bls_aggregated_commits_per_sec",
+                  bls_rate, "commits/s", bls_rate / ed_rate, n_vals=n_vals)
+            sizes[n_vals] = (len(commit_ed.encode()),
+                             len(commit_bls.encode()))
+    finally:
+        schemes.reset()
+    # informational: the wire-size collapse (48 B sig + signer bitmap +
+    # fixed header vs n_vals CommitSig entries) — never gated
+    ed_b, agg_b = sizes[1000]
+    _emit("aggregated_commit_1000val_bytes", float(agg_b), "bytes", 0.0,
+          ed25519_commit_bytes=ed_b,
+          agg_sig_bytes=48,
+          compression_ratio=round(ed_b / agg_b, 1))
+
+
 CONFIGS = {
     "1": bench_stream,
     "2": bench_verify_commit_150,
@@ -1323,6 +1441,7 @@ CONFIGS = {
     "churn": bench_churn,
     "crash": bench_crash,
     "exec": bench_exec,
+    "aggsig": bench_aggsig,
     "10k": bench_verify_commit_10k,
 }
 
@@ -1369,7 +1488,7 @@ if __name__ == "__main__":
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
             for key in ("2", "3", "4", "ingest", "churn", "crash", "exec",
-                        "5", "1", "multichip", "10k"):
+                        "aggsig", "5", "1", "multichip", "10k"):
                 for attempt in (1, 2):
                     try:
                         with _tracer.span(f"config_{key}"):

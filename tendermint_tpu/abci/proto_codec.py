@@ -87,9 +87,13 @@ def _dec_validator(body: bytes) -> abci.ABCIValidator:
     return out
 
 
+_PUBKEY_TYPE_TO_FIELD = {"ed25519": 1, "secp256k1": 2, "bls12381": 3}
+_PUBKEY_FIELD_TO_TYPE = {f: t for t, f in _PUBKEY_TYPE_TO_FIELD.items()}
+
+
 def _enc_validator_update(vu: abci.ValidatorUpdate) -> bytes:
     pk = pw.Writer()
-    pk.bytes(1 if vu.pub_key_type == "ed25519" else 2, vu.pub_key_bytes)
+    pk.bytes(_PUBKEY_TYPE_TO_FIELD.get(vu.pub_key_type, 2), vu.pub_key_bytes)
     w = pw.Writer()
     w.message(1, pk.finish())
     w.varint(2, vu.power)
@@ -101,7 +105,7 @@ def _dec_validator_update(body: bytes) -> abci.ValidatorUpdate:
     for fn, _wt, v in pw.iter_fields(body):
         if fn == 1:
             for pfn, _pwt, pv in pw.iter_fields(v):
-                out.pub_key_type = "ed25519" if pfn == 1 else "secp256k1"
+                out.pub_key_type = _PUBKEY_FIELD_TO_TYPE.get(pfn, "secp256k1")
                 out.pub_key_bytes = pv
         elif fn == 2:
             out.power = pw.varint_to_int64(v)

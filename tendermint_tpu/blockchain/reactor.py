@@ -501,6 +501,13 @@ class BlockchainReactor(Reactor):
         # per-block path: doubling a tiny batch buys nothing and must not
         # push it over the device-routing threshold (a cold XLA compile in a
         # fresh node process would dwarf the verification itself)
+        if any(hasattr(blk.last_commit, "agg_sig")
+               or hasattr(nxt.last_commit, "agg_sig")
+               for blk, _p, nxt, _np in pairs):
+            # aggregated commits verify via one pairing in
+            # verify_commit_light_batched, not an ed25519 device batch —
+            # nothing to precompute here
+            return None
         n_sigs = sum(len(blk.last_commit.signatures) if blk.last_commit else 0
                      for blk, _p, _n, _np in pairs) * 2
         if n_sigs < PRECOMPUTE_MIN_SIGS:

@@ -324,7 +324,7 @@ class _VoteSetReader:
     def size(self) -> int:
         if self._vote_set is not None:
             return self._vote_set.size()
-        return len(self._commit.signatures)
+        return self._commit.size()
 
     def is_commit(self) -> bool:
         return self._commit is not None
@@ -332,6 +332,9 @@ class _VoteSetReader:
     def bit_array(self) -> BitArray:
         if self._vote_set is not None:
             return self._vote_set.bit_array()
+        if hasattr(self._commit, "agg_sig"):
+            # no per-validator votes to offer — peers catch up via block sync
+            return BitArray(self._commit.size())
         ba = BitArray(len(self._commit.signatures))
         for i, cs in enumerate(self._commit.signatures):
             ba.set_index(i, not cs.absent())
@@ -340,6 +343,8 @@ class _VoteSetReader:
     def get_by_index(self, idx: int) -> Optional[Vote]:
         if self._vote_set is not None:
             return self._vote_set.get_by_index(idx)
+        if hasattr(self._commit, "agg_sig"):
+            return None
         if self._commit.signatures[idx].absent():
             return None
         return self._commit.get_vote(idx)

@@ -133,7 +133,9 @@ class Handshaker:
         if app_block_height == 0:
             validators = [Validator(v.address, v.pub_key, v.power)
                           for v in self.genesis.validators]
-            val_updates = [abci.ValidatorUpdate("ed25519", v.pub_key.bytes(), v.voting_power)
+            val_updates = [abci.ValidatorUpdate(v.pub_key.type_name,
+                                                v.pub_key.bytes(),
+                                                v.voting_power)
                            for v in validators]
             params = state.consensus_params
             req = abci.RequestInitChain(

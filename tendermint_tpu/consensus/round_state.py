@@ -156,9 +156,15 @@ class GotVoteFromUnwantedRound(Exception):
 
 
 def commit_to_vote_set(chain_id: str, commit: Commit,
-                       val_set: ValidatorSet) -> VoteSet:
+                       val_set: ValidatorSet) -> "VoteSet | AggregatedLastCommit":
     """Reconstruct the precommit VoteSet backing a Commit
-    (reference types/vote_set.go CommitToVoteSet in vote_set.go / block.go)."""
+    (reference types/vote_set.go CommitToVoteSet in vote_set.go / block.go).
+    An AggregatedCommit cannot be exploded back into votes (the per-validator
+    signatures are gone) — it is wrapped in the read-only adapter instead."""
+    if hasattr(commit, "agg_sig"):
+        val_set.verify_commit_light(chain_id, commit.block_id, commit.height,
+                                    commit)
+        return AggregatedLastCommit(chain_id, commit, val_set)
     vote_set = VoteSet(chain_id, commit.height, commit.round,
                        SignedMsgType.PRECOMMIT, val_set)
     for idx, cs in enumerate(commit.signatures):
@@ -168,3 +174,48 @@ def commit_to_vote_set(chain_id: str, commit: Commit,
         if not added:
             raise ValueError(f"failed to reconstruct LastCommit: vote {idx} not added")
     return vote_set
+
+
+class AggregatedLastCommit:
+    """Read-only stand-in for rs.last_commit after a restart on an
+    aggregated chain.  The stored AggregatedCommit has no per-validator
+    votes to re-add or gossip, so this adapter answers the VoteSet surface
+    the consensus core and reactor actually touch: the majority is already
+    proven (verified in commit_to_vote_set), make_commit returns the commit
+    verbatim for the next proposal, late precommits are dropped, and the
+    vote-gossip bit array is empty so nothing tries to fetch votes that no
+    longer exist (peers one height back catch up via block sync)."""
+
+    def __init__(self, chain_id: str, commit, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = commit.height
+        self.round = commit.round
+        self.signed_msg_type = SignedMsgType.PRECOMMIT
+        self.val_set = val_set
+        self._commit = commit
+
+    def size(self) -> int:
+        return self._commit.size()
+
+    def has_two_thirds_majority(self) -> bool:
+        return True
+
+    def two_thirds_majority(self):
+        return self._commit.block_id, True
+
+    def make_commit(self):
+        return self._commit
+
+    def add_vote(self, vote) -> bool:
+        return False  # nothing to accumulate into
+
+    def has_all(self) -> bool:
+        return self._commit.signers.is_full()
+
+    def bit_array(self):
+        from ..libs.bits import BitArray
+
+        return BitArray(self._commit.size())
+
+    def get_by_index(self, idx: int):
+        return None

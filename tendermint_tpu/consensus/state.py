@@ -206,9 +206,14 @@ class ConsensusState:
             missing = missing_power = 0
             our_addr = (self.priv_validator.get_pub_key().address()
                         if self.priv_validator is not None else None)
-            for i, cs in enumerate(block.last_commit.signatures):
+            aggregated = hasattr(block.last_commit, "agg_sig")
+            for i in range(block.last_commit.size()):
+                if aggregated:
+                    absent = not block.last_commit.signers.get_index(i)
+                else:
+                    absent = block.last_commit.signatures[i].absent()
                 _, val = lvals.get_by_index(i)
-                if cs.absent():
+                if absent:
                     missing += 1
                     if val is not None:
                         missing_power += val.voting_power
@@ -471,6 +476,12 @@ class ConsensusState:
 
     def update_to_state(self, state: State) -> None:
         """(state.go:574 updateToState)"""
+        from ..crypto import schemes
+
+        # idempotent: keeps the scheme registry current with the chain's
+        # consensus params (they can change via EndBlock updates)
+        schemes.register_chain(state.chain_id,
+                               state.consensus_params.signature)
         rs = self.rs
         if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
             raise RuntimeError(

@@ -69,8 +69,11 @@ def verify_light_client_attack(e: LightClientAttackEvidence, chain_id: str,
     happen in the pool once the light client lands (SURVEY.md stage 9)."""
     cb = e.conflicting_block
     if common_header.height != cb.height:
+        # commit_vals: aggregated commits pair against the conflicting
+        # block's own set (the bitmap indexes it); plain commits ignore it
         common_vals.verify_commit_light_trusting(
-            chain_id, cb.signed_header.commit, DEFAULT_TRUST_LEVEL)
+            chain_id, cb.signed_header.commit, DEFAULT_TRUST_LEVEL,
+            commit_vals=cb.validator_set)
     elif cb.signed_header.header.hash() != cb.signed_header.commit.block_id.hash:
         raise ValueError(
             "common height is the same as conflicting block height so expected the "

@@ -103,6 +103,10 @@ KNOWN_SITES = frozenset({
     # validation + re-execution machinery to earn the byte-parity
     # invariant instead of riding correct hints
     "exec.conflict",
+    # BLS aggregate-verify device path (crypto/bls12381/vec.py): a fired
+    # site strikes the jax apk aggregation, opening the device breaker and
+    # forcing the host scalar fallback — the verdict must not change
+    "crypto.bls_verify",
     # content-corruption (adversarial) sites — consulted via mutate()
     "net.corrupt",
     "statesync.lying_snapshot",

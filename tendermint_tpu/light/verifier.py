@@ -112,8 +112,11 @@ def verify_non_adjacent(trusted: SignedHeader, trusted_vals: ValidatorSet,
     from ..types.errors import ErrNotEnoughVotingPowerSigned
 
     try:
+        # commit_vals: aggregated commits pair against the commit-height set
+        # (the bitmap indexes into untrusted_vals); plain commits ignore it
         trusted_vals.verify_commit_light_trusting(
-            trusted.header.chain_id, untrusted.commit, trust_level)
+            trusted.header.chain_id, untrusted.commit, trust_level,
+            commit_vals=untrusted_vals)
     except ErrNotEnoughVotingPowerSigned as e:
         raise ErrNewValSetCantBeTrusted(str(e)) from e
     # last deliberately: untrusted set is attacker-sized (verifier.go:70)

@@ -173,9 +173,13 @@ class FilePV(PrivValidator):
 
     @staticmethod
     def generate(key_file_path: str = "", state_file_path: str = "",
-                 seed: Optional[bytes] = None) -> "FilePV":
-        pv = FilePV(crypto.Ed25519PrivKey.generate(seed), key_file_path, state_file_path)
-        return pv
+                 seed: Optional[bytes] = None,
+                 key_type: str = crypto.ED25519_TYPE) -> "FilePV":
+        if key_type == crypto.BLS12381_TYPE:
+            priv = crypto.Bls12381PrivKey.generate(seed)
+        else:
+            priv = crypto.Ed25519PrivKey.generate(seed)
+        return FilePV(priv, key_file_path, state_file_path)
 
     def save(self) -> None:
         if self.key_file_path:
@@ -193,7 +197,11 @@ class FilePV(PrivValidator):
     def load(key_file_path: str, state_file_path: str) -> "FilePV":
         with open(key_file_path) as f:
             d = json.load(f)
-        priv = crypto.Ed25519PrivKey(bytes.fromhex(d["priv_key"]["value"]))
+        key_bytes = bytes.fromhex(d["priv_key"]["value"])
+        if d["priv_key"].get("type") == crypto.BLS12381_TYPE:
+            priv: crypto.PrivKey = crypto.Bls12381PrivKey(key_bytes)
+        else:
+            priv = crypto.Ed25519PrivKey(key_bytes)
         pv = FilePV(priv, key_file_path, state_file_path)
         if os.path.exists(state_file_path):
             # a corrupt file raises CorruptSignStateError — startup must

@@ -67,6 +67,18 @@ def enc_header(h: Header) -> Dict[str, Any]:
 def enc_commit(c: Optional[Commit]) -> Optional[Dict[str, Any]]:
     if c is None:
         return None
+    if hasattr(c, "agg_sig"):
+        return {
+            "height": str(c.height),
+            "round": c.round,
+            "block_id": enc_block_id(c.block_id),
+            "aggregated_signature": {
+                "signers": "".join("1" if c.signers.get_index(i) else "0"
+                                   for i in range(c.signers.size())),
+                "signature": b64(c.agg_sig),
+                "timestamp": rfc3339(c.timestamp_ns),
+            },
+        }
     return {
         "height": str(c.height),
         "round": c.round,
@@ -124,10 +136,19 @@ def enc_block(b: Block) -> Dict[str, Any]:
     }
 
 
+_PUBKEY_JSON_TYPES = {
+    "ed25519": "tendermint/PubKeyEd25519",
+    "secp256k1": "tendermint/PubKeySecp256k1",
+    "sr25519": "tendermint/PubKeySr25519",
+    "bls12381": "tendermint/PubKeyBls12381",
+}
+
+
 def enc_validator(v: Validator) -> Dict[str, Any]:
     return {
         "address": hexu(v.address),
-        "pub_key": {"type": "tendermint/PubKeyEd25519",
+        "pub_key": {"type": _PUBKEY_JSON_TYPES.get(v.pub_key.type_name,
+                                                   "tendermint/PubKeyEd25519"),
                     "value": b64(v.pub_key.bytes())},
         "voting_power": str(v.voting_power),
         "proposer_priority": str(v.proposer_priority),

@@ -252,11 +252,15 @@ def get_begin_block_validator_info(block: Block, state_store: StateStore,
             raise ValueError(
                 f"commit size ({commit_size}) doesn't match valset length ({vals_size}) "
                 f"at height {block.header.height}")
+        aggregated = hasattr(block.last_commit, "agg_sig")
         for i, val in enumerate(last_val_set.validators):
-            cs = block.last_commit.signatures[i]
+            if aggregated:
+                signed = block.last_commit.signers.get_index(i)
+            else:
+                signed = not block.last_commit.signatures[i].absent()
             votes.append(abci.VoteInfo(
                 validator=abci.ABCIValidator(val.address, val.voting_power),
-                signed_last_block=not cs.absent()))
+                signed_last_block=signed))
     round_ = block.last_commit.round if block.last_commit else 0
     return abci.LastCommitInfo(round=round_, votes=votes)
 

@@ -84,6 +84,10 @@ class State:
 def median_time(commit: Commit, validators: ValidatorSet) -> int:
     """Voting-power-weighted median of commit vote timestamps
     (reference state/state.go:268 MedianTime)."""
+    if hasattr(commit, "agg_sig"):
+        # aggregated commits carry the weighted median precomputed at
+        # assembly time (the per-vote timestamps are not on the wire)
+        return commit.timestamp_ns
     weighted = []
     total_power = 0
     for cs in commit.signatures:
@@ -106,6 +110,11 @@ def median_time(commit: Commit, validators: ValidatorSet) -> int:
 def state_from_genesis(genesis: GenesisDoc) -> State:
     """(reference state/state.go MakeGenesisState)"""
     genesis.validate_and_complete()
+    from ..crypto import schemes
+
+    schemes.register_chain(
+        genesis.chain_id,
+        (genesis.consensus_params or ConsensusParams()).signature)
     if genesis.validators:
         vals = [Validator(v.address, v.pub_key, v.power) for v in genesis.validators]
         val_set = ValidatorSet(vals)

@@ -49,7 +49,8 @@ def validate_block(state, block: Block) -> None:
 
     # LastCommit — the batched hot path.
     if block.header.height == state.initial_height:
-        if block.last_commit is not None and len(block.last_commit.signatures) != 0:
+        # size() covers both forms: CommitSig rows or signer bitmap
+        if block.last_commit is not None and block.last_commit.size() != 0:
             raise ValueError("initial block can't have LastCommit signatures")
     else:
         state.last_validators.verify_commit(

@@ -12,8 +12,9 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from .. import crypto
-from ..crypto import merkle
+from ..crypto import merkle, schemes
 from ..libs import protowire as pw
+from ..libs.bits import BitArray
 from .basic import BlockID, BlockIDFlag, PartSetHeader, SignedMsgType, ZERO_TIME_NS
 from .canonical import (
     vote_sign_bytes,
@@ -312,23 +313,29 @@ class Commit:
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Canonical sign-bytes for validator val_idx's precommit (block.go:807)."""
         cs = self.signatures[val_idx]
+        ts = cs.timestamp_ns
+        if schemes.for_chain(chain_id).zero_precommit_ts:
+            ts = schemes.AGG_ZERO_TS_NS
         return vote_sign_bytes(
             chain_id,
             SignedMsgType.PRECOMMIT,
             self.height,
             self.round,
             cs.block_id(self.block_id),
-            cs.timestamp_ns,
+            ts,
         )
 
     def vote_sign_bytes_all(self, chain_id: str) -> List[bytes]:
         """Every validator's canonical sign-bytes in one pass, memoized per
-        chain_id. Batched commit verification needs all rows anyway, and the
-        shared-field assembly (canonical.vote_sign_bytes_batch) plus the memo
-        cut the dominant host-side cost of the device verify path. Commits
-        are immutable once built, so the memo never invalidates."""
+        (chain_id, zero-ts flag). Batched commit verification needs all rows
+        anyway, and the shared-field assembly
+        (canonical.vote_sign_bytes_batch) plus the memo cut the dominant
+        host-side cost of the device verify path. Commits are immutable once
+        built, so the memo only invalidates if the chain's scheme flips
+        zero_precommit_ts under us — hence the flag in the key."""
+        zero = schemes.for_chain(chain_id).zero_precommit_ts
         cache = self.__dict__.setdefault("_sb_cache", {})
-        hit = cache.get(chain_id)
+        hit = cache.get((chain_id, zero))
         if hit is None:
             hit = vote_sign_bytes_batch(
                 chain_id,
@@ -336,21 +343,27 @@ class Commit:
                 self.height,
                 self.round,
                 [cs.block_id(self.block_id) for cs in self.signatures],
-                [cs.timestamp_ns for cs in self.signatures],
+                [schemes.AGG_ZERO_TS_NS if zero else cs.timestamp_ns
+                 for cs in self.signatures],
             )
-            cache[chain_id] = hit
+            cache[(chain_id, zero)] = hit
         return hit
 
     def vote_sign_bytes_columns(self, chain_id: str):
         """Columnar sign-bytes (crypto.signcols.SignColumns) for the whole
-        commit, memoized per chain_id like vote_sign_bytes_all — or None
-        when the rows are not structurally uniform (nil votes mixed in,
-        ragged timestamp encodings). The batched verifiers hand this to the
-        device pack path so it never re-diffs what the encoder already
-        knew; row i reconstructs byte-identically to
-        vote_sign_bytes_all(chain_id)[i]."""
+        commit, memoized per (chain_id, scheme) like vote_sign_bytes_all — or
+        None when the rows are not structurally uniform (nil votes mixed in,
+        ragged timestamp encodings) or when the chain's scheme is not
+        ed25519: the columns feed the ed25519 device pack path exclusively,
+        and a memo keyed on chain_id alone would keep serving stale ed25519
+        columns after the chain registers a different scheme. Row i
+        reconstructs byte-identically to vote_sign_bytes_all(chain_id)[i]."""
+        sch = schemes.for_chain(chain_id)
+        if sch.scheme != schemes.SCHEME_ED25519:
+            return None
         cache = self.__dict__.setdefault("_sbc_cache", {})
-        hit = cache.get(chain_id, _NO_COLUMNS)
+        key = (chain_id, sch.scheme, sch.zero_precommit_ts)
+        hit = cache.get(key, _NO_COLUMNS)
         if hit is _NO_COLUMNS:
             hit = vote_sign_bytes_columns_batch(
                 chain_id,
@@ -360,7 +373,7 @@ class Commit:
                 [cs.block_id(self.block_id) for cs in self.signatures],
                 [cs.timestamp_ns for cs in self.signatures],
             )
-            cache[chain_id] = hit
+            cache[key] = hit
         return hit
 
     def size(self) -> int:
@@ -398,9 +411,16 @@ class Commit:
 
     @staticmethod
     def decode(data: bytes) -> "Commit":
+        """Polymorphic: the presence of the aggregate fields (5/6/7) makes
+        the wire form self-describing, so every existing decode call site —
+        block store, WAL, blocksync, light client — handles aggregated
+        commits without knowing the chain's scheme."""
         height = round_ = 0
         block_id = BlockID()
         sigs: List[CommitSig] = []
+        signers = None
+        agg_sig = b""
+        agg_ts = 0
         for fn, _wt, v in pw.iter_fields(data):
             if fn == 1:
                 height = pw.varint_to_int64(v)
@@ -410,7 +430,103 @@ class Commit:
                 block_id = BlockID.decode(v)
             elif fn == 4:
                 sigs.append(CommitSig.decode(v))
+            elif fn == 5:
+                signers = BitArray.decode(v)
+            elif fn == 6:
+                agg_sig = v
+            elif fn == 7:
+                agg_ts = pw.varint_to_int64(v)
+        if signers is not None or agg_sig:
+            return AggregatedCommit(height, round_, block_id, [],
+                                    signers=signers or BitArray(0),
+                                    agg_sig=agg_sig, timestamp_ns=agg_ts)
         return Commit(height, round_, block_id, sigs)
+
+
+@dataclass
+class AggregatedCommit(Commit):
+    """BLS fast-aggregate commit (the aggregated-commit block path; no
+    reference equivalent).  Replaces the per-validator CommitSig list with
+    one 48-byte aggregate signature over the shared zero-timestamp precommit
+    sign-bytes, a signer bitmap positioned by validator index, and the
+    voting-power-weighted median of the aggregated precommit timestamps.
+
+    Wire form reuses Commit fields 1-3 and adds signers=5, agg_sig=6,
+    timestamp=7; field 4 is never emitted, so Commit.decode dispatches on
+    5/6 presence.  Verification is one fast-aggregate-verify against the
+    apk of the bitmap's keys (validator_set.verify_commit*)."""
+
+    signers: BitArray = field(default_factory=lambda: BitArray(0))
+    agg_sig: bytes = b""
+    timestamp_ns: int = 0
+
+    def size(self) -> int:
+        return self.signers.size()
+
+    def signed(self, val_idx: int) -> bool:
+        return self.signers.get_index(val_idx)
+
+    def sign_message(self, chain_id: str) -> bytes:
+        """The single canonical payload every signer in the bitmap signed
+        (zero-timestamp precommit sign-bytes — see schemes.AGG_ZERO_TS_NS)."""
+        return vote_sign_bytes(
+            chain_id,
+            SignedMsgType.PRECOMMIT,
+            self.height,
+            self.round,
+            self.block_id,
+            schemes.AGG_ZERO_TS_NS,
+        )
+
+    def get_vote(self, val_idx: int):
+        raise TypeError("aggregated commit has no per-validator votes")
+
+    def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
+        raise TypeError("aggregated commit has no per-validator sign-bytes")
+
+    def vote_sign_bytes_all(self, chain_id: str):
+        raise TypeError("aggregated commit has no per-validator sign-bytes")
+
+    def vote_sign_bytes_columns(self, chain_id: str):
+        return None
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            w = pw.Writer()
+            w.message(1, self.signers.encode())
+            w.bytes(2, self.agg_sig)
+            w.varint(3, self.timestamp_ns)
+            self._hash = merkle.hash_from_byte_slices([w.finish()])
+        return self._hash
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.signatures:
+            raise ValueError("aggregated commit carries per-validator signatures")
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                raise ValueError("commit cannot be for nil block")
+            if self.signers.size() == 0 or self.signers.num_true() == 0:
+                raise ValueError("no signers in aggregated commit")
+            from ..crypto.bls12381 import SIG_SIZE
+
+            if len(self.agg_sig) != SIG_SIZE:
+                raise ValueError(
+                    f"aggregate signature must be {SIG_SIZE} bytes, "
+                    f"got {len(self.agg_sig)}")
+
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.varint(1, self.height)
+        w.varint(2, self.round)
+        w.message(3, self.block_id.encode())
+        w.message(5, self.signers.encode())
+        w.bytes(6, self.agg_sig)
+        w.varint(7, self.timestamp_ns)
+        return w.finish()
 
 
 @dataclass

@@ -21,6 +21,9 @@ class GenesisValidator:
     power: int
     name: str = ""
     address: bytes = b""
+    # BLS proof of possession; mandatory when the chain's signature scheme
+    # is bls12381 (the rogue-key gate), absent otherwise
+    pop: bytes = b""
 
     def __post_init__(self):
         if not self.address:
@@ -51,11 +54,25 @@ class GenesisDoc:
             self.consensus_params = default_consensus_params()
         else:
             self.consensus_params.validate_basic()
+        bls_chain = (self.consensus_params.signature.scheme == "bls12381")
         for i, v in enumerate(self.validators):
             if v.power == 0:
                 raise ValueError(f"the genesis file cannot contain validators with no voting power: {v}")
             if v.address and v.pub_key.address() != v.address:
                 raise ValueError(f"incorrect address for validator {i} in the genesis file")
+            if bls_chain:
+                # key registration: a BLS validator key enters the set only
+                # with a verified proof of possession (rogue-key defense)
+                if v.pub_key.type_name != "bls12381":
+                    raise ValueError(
+                        f"validator {i}: bls12381 chain requires bls12381 "
+                        f"keys, got {v.pub_key.type_name}")
+                from ..crypto import bls12381 as _bls
+
+                if not v.pop:
+                    raise ValueError(
+                        f"validator {i}: missing BLS proof of possession")
+                _bls.register_key(v.pub_key.bytes(), v.pop)
         if self.genesis_time_ns == 0:
             self.genesis_time_ns = time.time_ns()
 
@@ -82,17 +99,29 @@ class GenesisDoc:
                 "version": {"app_version": str(p.version.app_version)},
             }
 
+        def enc_params_full(p: ConsensusParams) -> dict:
+            out = enc_params(p)
+            if not p.signature.is_default:
+                # omitted for default chains: genesis JSON stays byte-for-
+                # byte what it was before the scheme plane existed
+                out["signature"] = {
+                    "scheme": p.signature.scheme,
+                    "aggregate_commits": p.signature.aggregate_commits,
+                }
+            return out
+
         doc = {
             "genesis_time": self.genesis_time_ns,
             "chain_id": self.chain_id,
             "initial_height": str(self.initial_height),
-            "consensus_params": enc_params(self.consensus_params or default_consensus_params()),
+            "consensus_params": enc_params_full(self.consensus_params or default_consensus_params()),
             "validators": [
                 {
                     "address": v.address.hex().upper(),
                     "pub_key": {"type": v.pub_key.type_name, "value": v.pub_key.bytes().hex()},
                     "power": str(v.power),
                     "name": v.name,
+                    **({"pop": v.pop.hex()} if v.pop else {}),
                 }
                 for v in self.validators
             ],
@@ -107,8 +136,10 @@ class GenesisDoc:
         params = None
         if "consensus_params" in doc and doc["consensus_params"]:
             cp = doc["consensus_params"]
-            from .params import BlockParams, EvidenceParams, ValidatorParams, VersionParams
+            from .params import (BlockParams, EvidenceParams, SignatureParams,
+                                 ValidatorParams, VersionParams)
 
+            sig = cp.get("signature") or {}
             params = ConsensusParams(
                 BlockParams(int(cp["block"]["max_bytes"]), int(cp["block"]["max_gas"]),
                             int(cp["block"].get("time_iota_ms", 1000))),
@@ -117,6 +148,8 @@ class GenesisDoc:
                                int(cp["evidence"].get("max_bytes", 1048576))),
                 ValidatorParams(list(cp["validator"]["pub_key_types"])),
                 VersionParams(int(cp.get("version", {}).get("app_version", 0))),
+                SignatureParams(sig.get("scheme", "ed25519"),
+                                bool(sig.get("aggregate_commits", False))),
             )
         validators = []
         for v in doc.get("validators") or []:
@@ -126,6 +159,7 @@ class GenesisDoc:
             validators.append(GenesisValidator(
                 pub_key=pub, power=int(v["power"]), name=v.get("name", ""),
                 address=bytes.fromhex(v["address"]) if v.get("address") else b"",
+                pop=bytes.fromhex(v["pop"]) if v.get("pop") else b"",
             ))
         gd = GenesisDoc(
             chain_id=doc["chain_id"],

@@ -17,6 +17,41 @@ MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB (types/params.go MaxBlockSizeBytes)
 ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
 ABCI_PUBKEY_TYPE_SECP256K1 = "secp256k1"
 ABCI_PUBKEY_TYPE_SR25519 = "sr25519"
+ABCI_PUBKEY_TYPE_BLS12381 = "bls12381"
+
+
+@dataclass
+class SignatureParams:
+    """Which signature scheme the chain's validators run and whether commits
+    are BLS-aggregated (this repo's scheme-agnostic crypto plane; no
+    reference equivalent).  The ed25519/non-aggregated default is encoded as
+    *absence* — no proto field, no genesis JSON section — so every default
+    chain stays byte-identical to the pre-scheme-plane format."""
+
+    scheme: str = ABCI_PUBKEY_TYPE_ED25519
+    aggregate_commits: bool = False
+
+    @property
+    def is_default(self) -> bool:
+        return (self.scheme == ABCI_PUBKEY_TYPE_ED25519
+                and not self.aggregate_commits)
+
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.string(1, self.scheme)
+        if self.aggregate_commits:
+            w.varint(2, 1)
+        return w.finish()
+
+    @staticmethod
+    def decode(data: bytes) -> "SignatureParams":
+        p = SignatureParams()
+        for fn, _wt, v in pw.iter_fields(data):
+            if fn == 1:
+                p.scheme = v.decode("utf-8")
+            elif fn == 2:
+                p.aggregate_commits = bool(v)
+        return p
 
 
 @dataclass
@@ -116,6 +151,7 @@ class ConsensusParams:
     evidence: EvidenceParams = field(default_factory=EvidenceParams)
     validator: ValidatorParams = field(default_factory=ValidatorParams)
     version: VersionParams = field(default_factory=VersionParams)
+    signature: SignatureParams = field(default_factory=SignatureParams)
 
     def hash(self) -> bytes:
         """HashConsensusParams (types/params.go): sha256 of HashedParams proto
@@ -146,8 +182,16 @@ class ConsensusParams:
             raise ValueError("len(Validator.PubKeyTypes) must be greater than 0")
         for t in self.validator.pub_key_types:
             if t not in (ABCI_PUBKEY_TYPE_ED25519, ABCI_PUBKEY_TYPE_SECP256K1,
-                         ABCI_PUBKEY_TYPE_SR25519):
+                         ABCI_PUBKEY_TYPE_SR25519, ABCI_PUBKEY_TYPE_BLS12381):
                 raise ValueError(f"unknown pubkey type {t}")
+        if self.signature.scheme not in (ABCI_PUBKEY_TYPE_ED25519,
+                                         ABCI_PUBKEY_TYPE_BLS12381):
+            raise ValueError(
+                f"unknown signature scheme {self.signature.scheme}")
+        if self.signature.aggregate_commits and \
+                self.signature.scheme != ABCI_PUBKEY_TYPE_BLS12381:
+            raise ValueError(
+                "signature.aggregate_commits requires the bls12381 scheme")
 
     def update(self, updates) -> "ConsensusParams":
         """Apply ABCI EndBlock param updates (types/params.go UpdateConsensusParams)."""
@@ -157,6 +201,8 @@ class ConsensusParams:
                            self.evidence.max_age_duration_ns, self.evidence.max_bytes),
             ValidatorParams(list(self.validator.pub_key_types)),
             VersionParams(self.version.app_version),
+            SignatureParams(self.signature.scheme,
+                            self.signature.aggregate_commits),
         )
         if updates is None:
             return res
@@ -179,6 +225,9 @@ class ConsensusParams:
         w.message(2, self.evidence.encode())
         w.message(3, self.validator.encode())
         w.message(4, self.version.encode())
+        if not self.signature.is_default:
+            # absent for default chains: pre-scheme-plane bytes unchanged
+            w.message(5, self.signature.encode())
         return w.finish()
 
     @staticmethod
@@ -193,6 +242,8 @@ class ConsensusParams:
                 p.validator = ValidatorParams.decode(v)
             elif fn == 4:
                 p.version = VersionParams.decode(v)
+            elif fn == 5:
+                p.signature = SignatureParams.decode(v)
         return p
 
 

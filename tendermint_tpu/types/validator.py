@@ -50,6 +50,9 @@ def pubkey_proto_bytes(pub: crypto.PubKey) -> bytes:
         w.bytes(1, pub.bytes())
     elif pub.type_name == "secp256k1":
         w.bytes(2, pub.bytes())
+    elif pub.type_name == crypto.BLS12381_TYPE:
+        # same oneof field the ABCI codec uses for validator updates
+        w.bytes(3, pub.bytes())
     else:
         raise ValueError(f"unsupported pubkey type {pub.type_name!r}")
     out = w.finish()
@@ -68,6 +71,8 @@ def pubkey_from_proto(data: bytes) -> crypto.PubKey:
             return crypto.Ed25519PubKey(v)
         if fn == 2:
             return crypto.pubkey_from_type_and_bytes("secp256k1", v)
+        if fn == 3:
+            return crypto.pubkey_from_type_and_bytes(crypto.BLS12381_TYPE, v)
     raise ValueError("empty PublicKey proto")
 
 

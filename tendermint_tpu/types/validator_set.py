@@ -35,6 +35,12 @@ from .validator import (
 Fraction = Tuple[int, int]
 
 
+def _is_aggregated(commit) -> bool:
+    """Duck-typed (types.block.AggregatedCommit carries agg_sig/signers) so
+    this module need not import types.block."""
+    return hasattr(commit, "agg_sig")
+
+
 def _by_voting_power(v: Validator):
     """Sort key: power desc, address asc (reference types/validator.go ValidatorsByVotingPower)."""
     return (-v.voting_power, v.address)
@@ -366,6 +372,8 @@ class ValidatorSet:
         """All signatures checked; absent skipped; nil votes verified but not
         tallied (validator_set.go:667)."""
         self._check_commit_shape(commit, height, block_id)
+        if _is_aggregated(commit):
+            return self._verify_aggregated(chain_id, commit)
         idxs = [i for i, cs in enumerate(commit.signatures) if not cs.absent()]
         ok = self._batch_verify(chain_id, commit, idxs)
         tallied = 0
@@ -383,6 +391,10 @@ class ValidatorSet:
         """Stops at 2/3: signatures after the early-exit point are never
         examined (validator_set.go:722) — the replay preserves that."""
         self._check_commit_shape(commit, height, block_id)
+        if _is_aggregated(commit):
+            # one pairing over the whole bitmap: there is no cheaper
+            # early-exit prefix to stop at
+            return self._verify_aggregated(chain_id, commit)
         idxs = [i for i, cs in enumerate(commit.signatures) if cs.for_block()]
         ok = self._batch_verify(chain_id, commit, idxs, plane="light")
         tallied = 0
@@ -395,8 +407,16 @@ class ValidatorSet:
                 return
         raise ErrNotEnoughVotingPowerSigned(tallied, needed)
 
-    def verify_commit_light_trusting(self, chain_id: str, commit, trust_level: Fraction) -> None:
-        """Address-lookup variant over a *trusted* set (validator_set.go:775)."""
+    def verify_commit_light_trusting(self, chain_id: str, commit,
+                                     trust_level: Fraction,
+                                     commit_vals: "ValidatorSet" = None) -> None:
+        """Address-lookup variant over a *trusted* set (validator_set.go:775).
+
+        `commit_vals` is only consulted for aggregated commits: the aggregate
+        signature covers every key in the signer bitmap — positioned by index
+        into the COMMIT's validator set, which the trusted set (self) may not
+        contain — so the pairing needs the commit-height set while the
+        trust-level tally intersects the bitmap with self."""
         numer, denom = trust_level
         if denom == 0:
             raise ValueError("trustLevel has zero Denominator")
@@ -407,6 +427,10 @@ class ValidatorSet:
                 "please provide smaller trustLevel numerator"
             )
         needed = total_mul // denom
+
+        if _is_aggregated(commit):
+            return self._verify_aggregated_trusting(
+                chain_id, commit, needed, commit_vals)
 
         # Candidates: for-block sigs whose address is in the trusted set.
         cand: List[Tuple[int, int, Validator]] = []  # (commit idx, val idx, val)
@@ -433,14 +457,64 @@ class ValidatorSet:
         raise ErrNotEnoughVotingPowerSigned(tallied, needed)
 
     def _check_commit_shape(self, commit, height: int, block_id: BlockID) -> None:
-        if self.size() != len(commit.signatures):
-            raise ErrInvalidCommitSignatures(self.size(), len(commit.signatures))
+        # commit.size(): CommitSig rows for plain commits, signer-bitmap
+        # length for aggregated ones — both must equal the set size
+        if self.size() != commit.size():
+            raise ErrInvalidCommitSignatures(self.size(), commit.size())
         if height != commit.height:
             raise ErrInvalidCommitHeight(height, commit.height)
         if block_id != commit.block_id:
             raise ValueError(
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
+
+    def _verify_aggregated(self, chain_id: str, commit) -> None:
+        """One fast-aggregate-verify replaces the per-signature batch: apk
+        over the bitmap's pubkeys, pairing against the shared zero-timestamp
+        sign-bytes. Error precedence mirrors the scalar replay — shape
+        (caller), then signature (ErrWrongSignature), then the 2/3 tally
+        (ErrNotEnoughVotingPowerSigned)."""
+        from ..crypto.bls12381.vec import fast_aggregate_verify_routed
+
+        signer_idxs = commit.signers.true_indices()
+        pks = [self.validators[i].pub_key.bytes() for i in signer_idxs]
+        msg = commit.sign_message(chain_id)
+        if not fast_aggregate_verify_routed(pks, msg, commit.agg_sig):
+            raise ErrWrongSignature(-1, commit.agg_sig)
+        tallied = sum(self.validators[i].voting_power for i in signer_idxs)
+        needed = self.total_voting_power() * 2 // 3
+        if tallied <= needed:
+            raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+    def _verify_aggregated_trusting(self, chain_id: str, commit, needed: int,
+                                    commit_vals: "ValidatorSet") -> None:
+        """Trusting-mode aggregate check: the pairing must run over the FULL
+        bitmap (the aggregate covers every signer), keyed by the commit
+        validator set; only the trusted intersection tallies toward the
+        trust level."""
+        from ..crypto.bls12381.vec import fast_aggregate_verify_routed
+
+        if commit_vals is None:
+            # self must BE the commit-height set then (e.g. evidence checks
+            # against the recorded set); a size mismatch means it is not
+            commit_vals = self
+        if commit_vals.size() != commit.size():
+            raise ErrInvalidCommitSignatures(commit_vals.size(), commit.size())
+        signer_idxs = commit.signers.true_indices()
+        pks = [commit_vals.validators[i].pub_key.bytes() for i in signer_idxs]
+        msg = commit.sign_message(chain_id)
+        if not fast_aggregate_verify_routed(pks, msg, commit.agg_sig):
+            raise ErrWrongSignature(-1, commit.agg_sig)
+        addr_idx = self._addr_index()
+        tallied = 0
+        for i in signer_idxs:
+            val_idx = addr_idx.get(commit_vals.validators[i].address)
+            if val_idx is None:
+                continue
+            tallied += self.validators[val_idx].voting_power
+            if tallied > needed:
+                return
+        raise ErrNotEnoughVotingPowerSigned(tallied, needed)
 
     def _batch_verify(self, chain_id: str, commit, idxs: Sequence[int],
                       pubkeys: Optional[Sequence] = None,
@@ -513,8 +587,20 @@ def verify_commit_light_batched(
     bv = BatchVerifier(plane="light")
     slices: List[Tuple[int, List[int]]] = []  # (batch offset, candidate idxs)
     shape_errors: List[Optional[Exception]] = []
+    agg_done: dict = {}  # entry position -> result for aggregated commits
     off = 0
-    for val_set, chain_id, block_id, height, commit in entries:
+    for pos_e, (val_set, chain_id, block_id, height, commit) in enumerate(entries):
+        if _is_aggregated(commit):
+            # already one pairing per commit — nothing to fold into the
+            # ed25519 batch; verify inline and record the outcome
+            try:
+                val_set.verify_commit_light(chain_id, block_id, height, commit)
+                agg_done[pos_e] = None
+            except Exception as e:
+                agg_done[pos_e] = e
+            shape_errors.append(None)
+            slices.append((off, []))
+            continue
         try:
             val_set._check_commit_shape(commit, height, block_id)
         except Exception as e:  # shape errors surface per-entry, not batch-wide
@@ -532,7 +618,11 @@ def verify_commit_light_batched(
     _, per_item = bv.verify()
 
     results: List[Optional[Exception]] = []
-    for entry, shape_err, (start, idxs) in zip(entries, shape_errors, slices):
+    for pos_e, (entry, shape_err, (start, idxs)) in enumerate(
+            zip(entries, shape_errors, slices)):
+        if pos_e in agg_done:
+            results.append(agg_done[pos_e])
+            continue
         if shape_err is not None:
             results.append(shape_err)
             continue
@@ -571,8 +661,20 @@ def verify_commit_light_trusting_batched(
     slices: List[Tuple[int, List[Tuple[int, int, Validator]]]] = []
     pre_errors: List[Optional[Exception]] = []
     needed_list: List[int] = []
+    agg_done: dict = {}  # entry position -> result for aggregated commits
     off = 0
-    for val_set, chain_id, commit, trust_level in entries:
+    for pos_e, (val_set, chain_id, commit, trust_level) in enumerate(entries):
+        if _is_aggregated(commit):
+            try:
+                val_set.verify_commit_light_trusting(chain_id, commit,
+                                                     trust_level)
+                agg_done[pos_e] = None
+            except Exception as e:
+                agg_done[pos_e] = e
+            pre_errors.append(None)
+            slices.append((off, []))
+            needed_list.append(0)
+            continue
         numer, denom = trust_level
         if denom == 0:
             pre_errors.append(ValueError("trustLevel has zero Denominator"))
@@ -607,8 +709,11 @@ def verify_commit_light_trusting_batched(
     _, per_item = bv.verify()
 
     results: List[Optional[Exception]] = []
-    for entry, pre_err, (start, cand), needed in zip(
-            entries, pre_errors, slices, needed_list):
+    for pos_e, (entry, pre_err, (start, cand), needed) in enumerate(zip(
+            entries, pre_errors, slices, needed_list)):
+        if pos_e in agg_done:
+            results.append(agg_done[pos_e])
+            continue
         if pre_err is not None:
             results.append(pre_err)
             continue

@@ -9,6 +9,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from .. import crypto
+from ..crypto import schemes
 from ..libs import protowire as pw
 from .basic import BlockID, SignedMsgType, ZERO_TIME_NS
 from .canonical import vote_sign_bytes
@@ -32,8 +33,14 @@ class Vote:
     signature: bytes = b""
 
     def sign_bytes(self, chain_id: str) -> bytes:
+        ts = self.timestamp_ns
+        if (self.type == SignedMsgType.PRECOMMIT
+                and schemes.for_chain(chain_id).zero_precommit_ts):
+            # aggregated chains sign one shared precommit payload; the real
+            # timestamp still travels in the Vote for the commit's median
+            ts = schemes.AGG_ZERO_TS_NS
         return vote_sign_bytes(
-            chain_id, self.type, self.height, self.round, self.block_id, self.timestamp_ns
+            chain_id, self.type, self.height, self.round, self.block_id, ts
         )
 
     def verify(self, chain_id: str, pub_key: crypto.PubKey) -> None:

@@ -260,12 +260,18 @@ class VoteSet:
     # -- commit building ---------------------------------------------------
 
     def make_commit(self) -> Commit:
-        """Requires an unambiguous 2/3 majority (vote_set.go:612)."""
+        """Requires an unambiguous 2/3 majority (vote_set.go:612).  On
+        aggregated chains the maj23 precommits fold into one
+        AggregatedCommit instead of a CommitSig list."""
         if self.signed_msg_type != SignedMsgType.PRECOMMIT:
             raise VoteSetError("Cannot MakeCommit() unless VoteSet.Type is PRECOMMIT")
+        from ..crypto import schemes
+
         with self._mtx:
             if self.maj23 is None:
                 raise VoteSetError("Cannot MakeCommit() unless a blockhash has +2/3")
+            if schemes.aggregated(self.chain_id):
+                return self._make_aggregated_commit()
             commit_sigs = []
             for v in self.votes:
                 cs = vote_to_commit_sig(v)
@@ -274,6 +280,42 @@ class VoteSet:
                     cs = CommitSig.new_absent()
                 commit_sigs.append(cs)
             return Commit(self.height, self.round, self.maj23, commit_sigs)
+
+    def _make_aggregated_commit(self):
+        """Called with the lock held, maj23 set.  Every maj23 precommit
+        signed the SAME zero-timestamp payload (Vote.sign_bytes on
+        aggregated chains), so the signatures fold into one 48-byte BLS
+        aggregate; nil/other-block votes simply stay out of the bitmap.
+        The commit timestamp is the voting-power-weighted median of the
+        included votes' (wire-carried) timestamps — the same WeightedMedian
+        state.median_time computes for CommitSig lists."""
+        from ..crypto import bls12381 as bls
+        from .block import AggregatedCommit
+
+        signers = BitArray(self.val_set.size())
+        sigs: List[bytes] = []
+        weighted = []
+        total_power = 0
+        for i, v in enumerate(self.votes):
+            if v is None or not v.block_id.is_complete() or v.block_id != self.maj23:
+                continue
+            signers.set_index(i, True)
+            sigs.append(v.signature)
+            power = self.val_set.validators[i].voting_power
+            weighted.append((v.timestamp_ns, power))
+            total_power += power
+        agg_sig = bls.aggregate(sigs)
+        weighted.sort()
+        median = total_power // 2
+        ts = 0
+        for t, power in weighted:
+            if median <= power:  # types/time/time.go:50 WeightedMedian
+                ts = t
+                break
+            median -= power
+        return AggregatedCommit(self.height, self.round, self.maj23, [],
+                                signers=signers, agg_sig=agg_sig,
+                                timestamp_ns=ts)
 
 
 def vote_to_commit_sig(v: Optional[Vote]) -> CommitSig:

@@ -47,6 +47,17 @@ def _reset_fault_state():
         md = sys.modules.get("tendermint_tpu.crypto.ed25519_jax.multidevice")
         if md is not None:
             md.reset_pool()
+        # scheme registry + BLS caches are likewise process-global; only
+        # touch them if a test actually imported those modules
+        sch = sys.modules.get("tendermint_tpu.crypto.schemes")
+        if sch is not None:
+            sch.reset()
+        bls = sys.modules.get("tendermint_tpu.crypto.bls12381")
+        if bls is not None:
+            bls.reset()
+        bvec = sys.modules.get("tendermint_tpu.crypto.bls12381.vec")
+        if bvec is not None:
+            bvec.reset_stats()
 
     _reset_all()
     yield

@@ -1,0 +1,151 @@
+"""BLS12-381 min-sig foundation: scalar spec self-consistency, aggregate
+semantics, proof-of-possession (the rogue-key gate), and the vectorized
+backends' verdict parity with the scalar path (numpy + jax limb engines
+behind the device breaker)."""
+
+import pytest
+
+from tendermint_tpu.crypto import bls12381 as bls
+from tendermint_tpu.crypto.bls12381 import vec
+
+
+def _keys(n, tag=b"t"):
+    sks = [bls.sk_from_seed(tag + bytes([i])) for i in range(n)]
+    return sks, [bls.sk_to_pk(sk) for sk in sks]
+
+
+def test_sign_verify_roundtrip():
+    sks, pks = _keys(3)
+    msg = b"tendermint-tpu bls"
+    for sk, pk in zip(sks, pks):
+        sig = bls.sign(sk, msg)
+        assert len(sig) == 48  # min-sig: signatures in G1, compressed
+        assert len(pk) == 96   # pubkeys in G2, compressed
+        assert bls.verify(pk, msg, sig)
+        assert not bls.verify(pk, msg + b"!", sig)
+    # a signature under one key must not verify under another
+    assert not bls.verify(pks[1], msg, bls.sign(sks[0], msg))
+
+
+def test_keygen_is_deterministic():
+    a = bls.sk_from_seed(b"fixed-seed")
+    b = bls.sk_from_seed(b"fixed-seed")
+    assert a == b
+    assert bls.sk_to_pk(a) == bls.sk_to_pk(b)
+    assert bls.sk_from_seed(b"other-seed") != a
+
+
+def test_fast_aggregate_verify_all_signers():
+    sks, pks = _keys(5)
+    msg = b"one shared zero-timestamp payload"
+    agg = bls.aggregate([bls.sign(sk, msg) for sk in sks])
+    assert len(agg) == 48  # the whole commit collapses to one G1 point
+    assert bls.fast_aggregate_verify(pks, msg, agg)
+    # any tampering of the aggregate breaks the pairing
+    assert not bls.fast_aggregate_verify(
+        pks, msg, bytes([agg[0] ^ 0x01]) + agg[1:])
+    # a missing signer's key in the apk breaks it too (bitmap mismatch)
+    assert not bls.fast_aggregate_verify(pks[:-1], msg, agg)
+    # ... as does an extra key that never signed
+    extra = bls.sk_to_pk(bls.sk_from_seed(b"extra"))
+    assert not bls.fast_aggregate_verify(pks + [extra], msg, agg)
+
+
+def test_aggregate_subset_matches_subset_apk():
+    sks, pks = _keys(6)
+    msg = b"subset"
+    idxs = [0, 2, 5]
+    agg = bls.aggregate([bls.sign(sks[i], msg) for i in idxs])
+    assert bls.fast_aggregate_verify([pks[i] for i in idxs], msg, agg)
+    assert not bls.fast_aggregate_verify(pks, msg, agg)
+
+
+def test_duplicate_signer_in_aggregate_rejected():
+    """A signature folded in twice no longer matches the once-per-key apk —
+    the differential suite leans on this for duplicate-signer parity."""
+    sks, pks = _keys(3)
+    msg = b"dup"
+    sigs = [bls.sign(sk, msg) for sk in sks]
+    doubled = bls.aggregate(sigs + [sigs[0]])
+    assert not bls.fast_aggregate_verify(pks, msg, doubled)
+
+
+def test_pop_prove_verify_and_rogue_key_gate():
+    sks, pks = _keys(2, b"p")
+    pop0 = bls.pop_prove(sks[0])
+    assert bls.pop_verify(pks[0], pop0)
+    # a pop is bound to ITS key: replaying it for another fails
+    assert not bls.pop_verify(pks[1], pop0)
+    # the signing DST must not double as the pop DST (domain separation)
+    assert not bls.pop_verify(pks[0], bls.sign(sks[0], pks[0]))
+    bls.register_key(pks[0], pop0)
+    assert bls.is_registered(pks[0])
+    with pytest.raises(ValueError):
+        bls.register_key(pks[1], pop0)
+    assert not bls.is_registered(pks[1])
+
+
+def test_decompress_rejects_garbage():
+    # malformed / infinity / out-of-subgroup encodings resolve to None...
+    assert bls.decompress_pubkey(b"\x00" * 96) is None
+    assert bls.decompress_pubkey(b"\xc0" + b"\x00" * 95) is None  # infinity
+    # ...and any verify over them is a clean False, never a crash
+    assert not bls.verify(b"\x00" * 96, b"m", bls.sign(
+        bls.sk_from_seed(b"x"), b"m"))
+    assert not bls.verify(bls.sk_to_pk(bls.sk_from_seed(b"x")),
+                          b"m", b"\xff" * 48)
+
+
+@pytest.mark.parametrize("backend", [
+    "numpy",
+    # the jax tree-reduction kernel takes minutes of XLA compile on a CPU
+    # host (same story as the ed25519 verify kernel) — full-path parity
+    # stays out of tier-1; the n==1 probe test below and the aggsig.degrade
+    # chaos cell keep the jax routing covered there
+    pytest.param("jax", marks=pytest.mark.slow),
+])
+def test_vector_backend_verdict_parity(backend):
+    """Both limb engines must return the scalar path's exact verdicts —
+    they are an on-ramp for the device plane, never a semantics change."""
+    sks, pks = _keys(4, b"v")
+    msg = b"parity"
+    good = bls.aggregate([bls.sign(sk, msg) for sk in sks])
+    bad = bytes([good[0] ^ 0x01]) + good[1:]
+    vec.reset_stats()
+    assert vec.fast_aggregate_verify_routed(pks, msg, good, backend=backend)
+    assert not vec.fast_aggregate_verify_routed(pks, msg, bad, backend=backend)
+    assert vec.fast_aggregate_verify_routed(pks, msg, good, backend="scalar")
+    used = "device_calls" if backend == "jax" else "host_vec_calls"
+    assert vec.stats[used] >= 2, dict(vec.stats)
+    assert vec.stats["scalar_calls"] >= 1, dict(vec.stats)
+
+
+def test_montgomery_limb_roundtrip_both_geometries():
+    for cfg in (vec.CFG_NP, vec.CFG_JAX):
+        x = 0x1234567890ABCDEF ** 4 % vec.P
+        limbs = cfg.to_limbs_np(x)
+        back = sum(int(l) << (cfg.limb * i) for i, l in enumerate(limbs))
+        assert back == x, (cfg.nlimbs, cfg.limb)
+
+
+def test_single_key_fast_aggregate_is_plain_verify():
+    sks, pks = _keys(1, b"s")
+    msg = b"n=1"
+    sig = bls.sign(sks[0], msg)
+    assert bls.fast_aggregate_verify(pks, msg, sig)
+    assert bls.verify(pks[0], msg, sig)
+
+
+def test_jax_single_key_probe_path():
+    """The n==1 jax route (a Montgomery limb roundtrip as device evidence —
+    what the breaker's half-open probe rides) must agree with scalar and
+    count as a device call; cheap enough for tier-1 unlike the full
+    tree-reduction kernel."""
+    sks, pks = _keys(1, b"j")
+    msg = b"probe"
+    sig = bls.sign(sks[0], msg)
+    vec.reset_stats()
+    assert vec.fast_aggregate_verify_routed(pks, msg, sig, backend="jax")
+    assert not vec.fast_aggregate_verify_routed(
+        pks, msg, bytes([sig[0] ^ 0x01]) + sig[1:], backend="jax")
+    assert vec.stats["device_calls"] >= 2, dict(vec.stats)

@@ -44,7 +44,8 @@ from typing import Dict, List, Optional
 DEFAULT_THRESHOLD = 0.30
 
 #: units gated as higher-is-better throughput
-HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s"}
+HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s",
+                       "commits/s"}
 #: units gated as lower-is-better latency
 LOWER_BETTER_UNITS = {"s", "ms"}
 #: ratio-unit metrics gated lower-is-better DESPITE ratios defaulting to
@@ -483,6 +484,47 @@ def self_test() -> int:
         # ...while the exec phase breakdown stays informational
         assert gate_direction("inproc_exec4_phase_breakdown",
                               "ratio") is None
+        # the aggregate-signature A/B rows gate higher-better in BOTH
+        # directions on the commits/s unit: a collapsed BLS verify rate
+        # regresses, a jump reads improved, and the informational
+        # commit-size row (unit "bytes") never gates
+        ag_base = os.path.join(d, "aggsig_base.json")
+        _write(ag_base, {
+            "verify_commit_1000val_ed25519_batched_commits_per_sec":
+                (3.0, "commits/s"),
+            "verify_commit_1000val_bls_aggregated_commits_per_sec":
+                (16.0, "commits/s"),
+            "aggregated_commit_1000val_bytes": (190.0, "bytes")})
+        ag_bad = os.path.join(d, "aggsig_bad.json")
+        _write(ag_bad, {
+            "verify_commit_1000val_ed25519_batched_commits_per_sec":
+                (3.0, "commits/s"),
+            "verify_commit_1000val_bls_aggregated_commits_per_sec":
+                (4.0, "commits/s"),
+            "aggregated_commit_1000val_bytes": (700.0, "bytes")})
+        assert main([ag_base, ag_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ag_base), load_bench(ag_bad), {})}
+        assert rows["verify_commit_1000val_bls_aggregated_commits_per_sec"][
+            "status"] == "regressed"
+        assert rows["aggregated_commit_1000val_bytes"]["status"] == "info"
+        ag_fast = os.path.join(d, "aggsig_fast.json")
+        _write(ag_fast, {
+            "verify_commit_1000val_ed25519_batched_commits_per_sec":
+                (3.0, "commits/s"),
+            "verify_commit_1000val_bls_aggregated_commits_per_sec":
+                (40.0, "commits/s"),
+            "aggregated_commit_1000val_bytes": (190.0, "bytes")})
+        assert main([ag_base, ag_fast]) == 0
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ag_base), load_bench(ag_fast), {})}
+        assert rows["verify_commit_1000val_bls_aggregated_commits_per_sec"][
+            "status"] == "improved"
+        # ...and the loosened per-metric threshold un-trips the regression
+        assert main([
+            "--threshold",
+            "verify_commit_1000val_bls_aggregated_commits_per_sec=0.9",
+            ag_base, ag_bad]) == 0
         # the driver's record format ({"tail": jsonl}) parses identically
         drv = os.path.join(d, "driver.json")
         with open(drv, "w") as f:

@@ -135,6 +135,8 @@ SITES = {
     "churn.corrupt32": True,
     # execution cells (the parallel-execution plane; state/parallel.py)
     "exec.conflict_storm": False,
+    # aggregate-signature cells (the BLS commit plane; crypto/bls12381)
+    "aggsig.degrade": False,
     # crash cells (process death as the fault; tools/crashmatrix.py plane)
     "crash.torn_wal": False,
     "crash.privval": False,
@@ -1159,6 +1161,62 @@ def cell_exec_conflict_storm(seed: int) -> None:
     assert serial == parallel, "conflict storm diverged from serial spec"
 
 
+def cell_aggsig_degrade(seed: int) -> None:
+    """BLS aggregate-verify under device strikes: the armed
+    ``crypto.bls_verify`` site fails EVERY jax apk aggregation, the device
+    breaker opens, and every single verify still returns the host-scalar
+    verdict — zero dropped commits, accept AND reject parity throughout
+    the degradation. After disarm + cooldown, a single-key aggregate (the
+    n==1 device-evidence probe in aggregate_pubkeys_vec) re-closes the
+    breaker."""
+    from tendermint_tpu.crypto import bls12381 as bls
+    from tendermint_tpu.crypto.bls12381 import vec
+    from tendermint_tpu.crypto.breaker import CLOSED, OPEN, device_breaker
+    from tendermint_tpu.libs.faults import faults
+
+    device_breaker.failure_threshold = 2
+    # long cooldown while armed: the scalar-fallback pairing (~100 ms)
+    # must not outlast the OPEN window, or every call would be a fresh
+    # half-open probe and no breaker rejection would ever be observed
+    device_breaker.cooldown_s = 30.0
+    vec.reset_stats()
+    bls.reset()
+
+    sks = [bls.sk_from_seed(bytes([seed & 0xFF, i])) for i in range(4)]
+    pks = [bls.sk_to_pk(sk) for sk in sks]
+    msg = b"aggsig-degrade-%d" % seed
+    good = bls.aggregate([bls.sign(sk, msg) for sk in sks])
+    bad = bytes([good[0] ^ 0x01]) + good[1:]
+
+    faults.configure("crypto.bls_verify@1.0", seed=seed)
+    try:
+        for round_ in range(6):
+            # every call lands a verdict (fallback, never a drop), and the
+            # verdict matches the scalar spec for valid AND tampered input
+            assert vec.fast_aggregate_verify_routed(pks, msg, good,
+                                                    backend="jax"), \
+                f"round {round_}: valid aggregate rejected under injection"
+            assert not vec.fast_aggregate_verify_routed(pks, msg, bad,
+                                                        backend="jax"), \
+                f"round {round_}: tampered aggregate accepted under injection"
+        assert faults.fires("crypto.bls_verify") > 0, "site never fired"
+        assert vec.stats["device_errors"] >= 2, vec.stats
+        assert vec.stats["breaker_rejections"] > 0, \
+            "breaker never opened under 100% strikes"
+        assert device_breaker.state == OPEN, device_breaker.state
+    finally:
+        faults.reset()
+    device_breaker.cooldown_s = 0.05
+    time.sleep(0.06)
+    # half-open probe with REAL device evidence: the single-key aggregate
+    # runs the Montgomery limb roundtrip on the jax backend
+    assert vec.fast_aggregate_verify_routed(
+        [pks[0]], pks[0], bls.pop_prove(sks[0]), dst=bls.DST_POP,
+        backend="jax")
+    assert device_breaker.state == CLOSED, device_breaker.state
+    assert vec.stats["device_calls"] >= 1, vec.stats
+
+
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
     "device.lane": cell_device_lane,
@@ -1178,6 +1236,7 @@ CELLS = {
     "churn.partition32": cell_churn_partition32,
     "churn.corrupt32": cell_churn_corrupt32,
     "exec.conflict_storm": cell_exec_conflict_storm,
+    "aggsig.degrade": cell_aggsig_degrade,
     "crash.torn_wal": cell_crash_torn_wal,
     "crash.privval": cell_crash_privval,
     "crash.loop": cell_crash_loop,

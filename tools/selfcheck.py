@@ -2,7 +2,7 @@
 
 The tools directory is the operator's toolbox (trace_summary, trace_merge,
 fleet_scrape, bench_compare, chaos_matrix, device_profile, loadtime,
-churn, crashmatrix) and each carries
+churn, crashmatrix, aggsig_bench) and each carries
 a built-in --self-test. This runner discovers them (any tools/*.py whose source
 mentions --self-test) and executes each in a subprocess — argument
 parsing, imports, and exit codes included — so a refactor that rots a tool
@@ -86,7 +86,8 @@ def self_test() -> int:
     for expected in ("trace_summary.py", "trace_merge.py",
                      "fleet_scrape.py", "bench_compare.py",
                      "chaos_matrix.py", "device_profile.py",
-                     "loadtime.py", "churn.py", "crashmatrix.py"):
+                     "loadtime.py", "churn.py", "crashmatrix.py",
+                     "aggsig_bench.py"):
         assert expected in tools, (expected, tools)
     assert os.path.basename(__file__) not in tools  # no recursion
     # prove the runner distinguishes pass from fail without running the
