@@ -97,6 +97,8 @@ def _enc_validator_update(vu: abci.ValidatorUpdate) -> bytes:
     w = pw.Writer()
     w.message(1, pk.finish())
     w.varint(2, vu.power)
+    if vu.pop:  # bls12381 proof of possession; absent elsewhere
+        w.bytes(3, vu.pop)
     return w.finish()
 
 
@@ -109,6 +111,8 @@ def _dec_validator_update(body: bytes) -> abci.ValidatorUpdate:
                 out.pub_key_bytes = pv
         elif fn == 2:
             out.power = pw.varint_to_int64(v)
+        elif fn == 3:
+            out.pop = v
     return out
 
 

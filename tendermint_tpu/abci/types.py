@@ -37,6 +37,10 @@ class ValidatorUpdate:
     pub_key_type: str = "ed25519"
     pub_key_bytes: bytes = b""
     power: int = 0
+    # bls12381 keys must arrive with a proof of possession — the rogue-key
+    # gate validate_validator_updates enforces before admission; unused for
+    # every other scheme
+    pop: bytes = b""
 
 
 @dataclass

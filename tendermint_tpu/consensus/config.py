@@ -47,6 +47,12 @@ class ConsensusConfig:
     # 0 disables (default: a net configured to idle between txs would
     # false-positive); e2e/chaos nets enable it.
     stall_watchdog_s: float = 0.0
+    # Aggregated commits: the commit timestamp is covered by NO signature
+    # (precommits sign zero-timestamp bytes), so before prevoting a proposal
+    # each validator subjectively bounds the proposed last-commit timestamp
+    # within this drift of its own recorded precommit times / local clock
+    # (ConsensusState._check_aggregated_commit_time). 0 disables the check.
+    agg_commit_time_drift_s: float = 10.0
 
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

@@ -25,7 +25,6 @@ from ..types.block import BLOCK_PROTOCOL
 from ..types.event_bus import EventBus
 from ..types.part_set import Part
 from ..types.proposal import Proposal
-from ..types.validator import Validator
 from ..types.vote import Vote
 from .state import BlockPartMessage, ConsensusState, ProposalMessage, VoteMessage
 from .wal import TimeoutInfo, WALMessage
@@ -131,12 +130,12 @@ class Handshaker:
 
         # InitChain at genesis (replay.go:303-356)
         if app_block_height == 0:
-            validators = [Validator(v.address, v.pub_key, v.power)
-                          for v in self.genesis.validators]
+            # pop rides along so an app that echoes the set back in
+            # ResponseInitChain passes the bls12381 admission gate
             val_updates = [abci.ValidatorUpdate(v.pub_key.type_name,
                                                 v.pub_key.bytes(),
-                                                v.voting_power)
-                           for v in validators]
+                                                v.power, pop=v.pop)
+                           for v in self.genesis.validators]
             params = state.consensus_params
             req = abci.RequestInitChain(
                 time_ns=self.genesis.genesis_time_ns,
@@ -153,6 +152,12 @@ class Handshaker:
                 state = state.copy()
                 state.app_hash = app_hash
                 if res.validators:
+                    # same admission rules as EndBlock updates — in
+                    # particular the bls12381 proof-of-possession gate
+                    from ..state.execution import validate_validator_updates
+
+                    validate_validator_updates(res.validators,
+                                               state.consensus_params)
                     vals = [validator_update_to_validator(vu) for vu in res.validators]
                     from ..types import ValidatorSet
 

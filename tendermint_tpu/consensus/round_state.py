@@ -209,6 +209,11 @@ class AggregatedLastCommit:
     def add_vote(self, vote) -> bool:
         return False  # nothing to accumulate into
 
+    def list_votes(self):
+        # no per-validator votes survive aggregation — the subjective
+        # commit-time window check then falls back to its clock bound
+        return []
+
     def has_all(self) -> bool:
         return self._commit.signers.is_full()
 

@@ -79,6 +79,29 @@ def now_ns() -> int:
     return time.time_ns()
 
 
+def check_aggregated_commit_time(commit, seen_ts_ns, now_ns_, drift_ns) -> None:
+    """Window check behind ConsensusState._check_aggregated_commit_time
+    (split out so it is testable without a live state machine).
+
+    `seen_ts_ns` are the precommit timestamps THIS node recorded for the
+    commit's height from validators inside the signer bitmap; possibly a
+    subset of what the proposer aggregated over, so the window keeps
+    drift-sized slack on both sides.  Raises ValueError on a timestamp
+    outside [min(seen)-drift, max(seen)+drift] or more than drift ahead of
+    the local clock."""
+    ts = commit.timestamp_ns
+    if ts > now_ns_ + drift_ns:
+        raise ValueError(
+            f"aggregated commit timestamp {ts} is more than "
+            f"{drift_ns / 1e9:g}s ahead of local time {now_ns_}")
+    if seen_ts_ns:
+        lo, hi = min(seen_ts_ns) - drift_ns, max(seen_ts_ns) + drift_ns
+        if not lo <= ts <= hi:
+            raise ValueError(
+                f"aggregated commit timestamp {ts} outside the window "
+                f"[{lo}, {hi}] of locally recorded precommit times")
+
+
 class ConsensusState:
     def __init__(self, config: ConsensusConfig, state: State,
                  block_exec: BlockExecutor, block_store: BlockStore,
@@ -740,12 +763,43 @@ class ConsensusState:
             return
         try:
             self.block_exec.validate_block(self.state, rs.proposal_block)
+            self._check_aggregated_commit_time(rs.proposal_block)
         except Exception as e:
             logger.error("prevote step: ProposalBlock is invalid: %s", e)
             self._sign_add_vote(SignedMsgType.PREVOTE, b"", PartSetHeader())
             return
         self._sign_add_vote(SignedMsgType.PREVOTE, rs.proposal_block.hash(),
                             rs.proposal_block_parts.header())
+
+    def _check_aggregated_commit_time(self, block) -> None:
+        """Subjective BFT-time guard for aggregated commits, prevote-only.
+
+        Aggregated precommits sign zero-timestamp bytes (schemes
+        AGG_ZERO_TS_NS), so AggregatedCommit.timestamp_ns — and with it
+        header.time_ns, which validate_block pins to it — is
+        proposer-assembled and covered by NO signature.  Deterministic
+        validation can only enforce monotonicity; the rest of BFT time is
+        recovered here, subjectively, before prevoting: the proposed
+        last-commit timestamp must sit within agg_commit_time_drift_s of
+        the precommit timestamps this node itself recorded for the previous
+        height (when it tracked them) and never run ahead of the local
+        clock by more than the drift.  A proposer-invented future time then
+        draws nil prevotes from every honest validator and cannot reach a
+        quorum.  Plain CommitSig commits carry signed per-vote timestamps
+        and need none of this."""
+        commit = block.last_commit
+        if commit is None or not hasattr(commit, "agg_sig"):
+            return
+        drift_s = self.config.agg_commit_time_drift_s
+        if drift_s <= 0:
+            return
+        seen_ts = []
+        if self.rs.last_commit is not None:
+            seen_ts = [v.timestamp_ns for v in self.rs.last_commit.list_votes()
+                       if v.block_id == commit.block_id
+                       and commit.signers.get_index(v.validator_index)]
+        check_aggregated_commit_time(commit, seen_ts, now_ns(),
+                                     int(drift_s * 1e9))
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
         """(state.go:1286)"""

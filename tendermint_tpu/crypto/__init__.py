@@ -166,14 +166,20 @@ class Bls12381PubKey(PubKey):
 def _bls_pubkey_bytes(sk_bytes: bytes) -> bytes:
     from . import bls12381 as _bls
 
-    cached = _bls_pubkey_bytes._memo.get(sk_bytes)
+    # keyed on a digest so the module-global memo never retains raw
+    # secret-key bytes, and bounded so it cannot grow with key churn
+    memo_key = hashlib.sha256(b"tmtpu-bls-pk-memo" + sk_bytes).digest()
+    cached = _bls_pubkey_bytes._memo.get(memo_key)
     if cached is None:  # one G2 scalar mul (~15 ms) — memoize per secret
+        if len(_bls_pubkey_bytes._memo) >= _BLS_PK_MEMO_MAX:
+            _bls_pubkey_bytes._memo.clear()
         cached = _bls.sk_to_pk(_bls.sk_from_bytes(sk_bytes))
-        _bls_pubkey_bytes._memo[sk_bytes] = cached
+        _bls_pubkey_bytes._memo[memo_key] = cached
     return cached
 
 
 _bls_pubkey_bytes._memo = {}
+_BLS_PK_MEMO_MAX = 256
 
 
 @dataclass(frozen=True)

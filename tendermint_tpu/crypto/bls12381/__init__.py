@@ -16,6 +16,13 @@ Decompression + subgroup checks are memoized per byte-string (subgroup
 check = one scalar mul by r, the dominant cost), as is the aggregate
 pubkey per signer-set.  `reset()` drops every cache; the test harness calls
 it between tests.
+
+Known limitation: the pure-Python double-and-add in curve.g1_mul/g2_mul is
+VARIABLE-TIME in the scalar — signing leaks timing about the secret key.
+That is acceptable for this scalar spec plane (tests, benches, in-proc
+nets) but rules out the pure-Python signer for keys that face untrusted
+network observers; a production deployment wants a constant-time native
+backend behind the same sign/verify surface.
 """
 
 from __future__ import annotations

@@ -176,11 +176,11 @@ class BlockExecutor:
 
         self.state_store.save_abci_responses(block.header.height, abci_responses)
 
-        validator_updates = [
-            validator_update_to_validator(vu)
-            for vu in (abci_responses.end_block.validator_updates if abci_responses.end_block else [])
-        ]
-        validate_validator_updates(validator_updates, state.consensus_params)
+        raw_updates = (abci_responses.end_block.validator_updates
+                       if abci_responses.end_block else [])
+        validate_validator_updates(raw_updates, state.consensus_params)
+        validator_updates = [validator_update_to_validator(vu)
+                             for vu in raw_updates]
 
         new_state = update_state(state, block_id, block, abci_responses, validator_updates)
 
@@ -286,17 +286,42 @@ def validator_update_to_validator(vu: abci.ValidatorUpdate) -> Validator:
     return Validator(pub.address(), pub, vu.power)
 
 
-def validate_validator_updates(updates: List[Validator], params: ConsensusParams) -> None:
-    """(state/validation.go validateValidatorUpdates)"""
-    for v in updates:
-        if v.voting_power < 0:
-            raise ValueError(f"voting power can't be negative: {v}")
-        if v.voting_power == 0:
+def validate_validator_updates(updates: List[abci.ValidatorUpdate],
+                               params: ConsensusParams) -> None:
+    """(state/validation.go validateValidatorUpdates) — takes the RAW ABCI
+    updates so bls12381 admissions can be held to their proof of possession:
+    an aggregated chain with a dynamic validator set is exactly where a
+    rogue key (pk* - sum of honest pks) would let an attacker forge
+    fast-aggregate commits, so the PoP gate that genesis enforces must also
+    cover every key entering via EndBlock/InitChain."""
+    from ..crypto import BLS12381_TYPE
+    from ..crypto import bls12381 as _bls
+
+    for vu in updates:
+        if vu.power < 0:
+            raise ValueError(f"voting power can't be negative: {vu}")
+        if vu.power == 0:
             continue  # deletion
-        if v.pub_key.type_name not in params.validator.pub_key_types:
+        if vu.pub_key_type not in params.validator.pub_key_types:
             raise ValueError(
-                f"validator {v.address.hex()} is using pubkey {v.pub_key.type_name}, "
-                f"which is unsupported for consensus")
+                f"validator update with pubkey {vu.pub_key_bytes.hex()} is using "
+                f"pubkey type {vu.pub_key_type}, which is unsupported for consensus")
+        if vu.pub_key_type == BLS12381_TYPE:
+            # Every bls12381 admission (including a power change for a
+            # sitting validator) must carry a valid PoP.  Deliberately NOT
+            # short-circuited through is_registered: that set is in-process
+            # state, and a freshly restarted node (empty set) must reach the
+            # same verdict as a long-running one.
+            if not vu.pop:
+                raise ValueError(
+                    f"bls12381 validator update {vu.pub_key_bytes.hex()} has no "
+                    f"proof of possession (rogue-key gate)")
+            if not _bls.pop_verify(vu.pub_key_bytes, vu.pop):
+                raise ValueError(
+                    f"invalid bls12381 proof of possession for validator "
+                    f"update {vu.pub_key_bytes.hex()}")
+            # vetted above — joins the aggregation-eligible set
+            _bls.register_key(vu.pub_key_bytes, vu.pop)
 
 
 def update_state(state: State, block_id: BlockID, block: Block,

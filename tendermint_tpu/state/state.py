@@ -85,8 +85,16 @@ def median_time(commit: Commit, validators: ValidatorSet) -> int:
     """Voting-power-weighted median of commit vote timestamps
     (reference state/state.go:268 MedianTime)."""
     if hasattr(commit, "agg_sig"):
-        # aggregated commits carry the weighted median precomputed at
-        # assembly time (the per-vote timestamps are not on the wire)
+        # Aggregated commits carry the weighted median precomputed at
+        # assembly time — the per-vote timestamps are not on the wire, and
+        # the aggregate signature does NOT cover timestamp_ns (every
+        # precommit signs zero-timestamp bytes, schemes.AGG_ZERO_TS_NS).
+        # BFT time therefore weakens to proposer-assembled time bounded by
+        # (a) deterministic monotonicity vs the previous block
+        # (validation.validate_block) and (b) the subjective prevote-time
+        # window each validator enforces against its own recorded precommit
+        # times and local clock (consensus.state.check_aggregated_commit_time,
+        # agg_commit_time_drift_s knob).
         return commit.timestamp_ns
     weighted = []
     total_power = 0

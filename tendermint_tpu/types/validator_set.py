@@ -653,9 +653,13 @@ def verify_commit_light_trusting_batched(
     scalar precedence loop (address lookup, duplicate-vote check, trust-level
     tally with early exit) replays over its verdict slice.
 
-    Entries: (trusted_val_set, chain_id, commit, trust_level).
-    Per-entry outcome is None (ok) or the exact exception
-    verify_commit_light_trusting would have raised.
+    Entries: (trusted_val_set, chain_id, commit, trust_level) or, for
+    aggregated commits crossing a valset change, the 5-tuple
+    (..., commit_vals) carrying the commit-height validator set — the
+    bitmap indexes into THAT set, so the pairing needs it whenever it
+    differs from the trusted set (mirrors light/verifier.py
+    verify_non_adjacent).  Per-entry outcome is None (ok) or the exact
+    exception verify_commit_light_trusting would have raised.
     """
     bv = BatchVerifier(plane="light")
     slices: List[Tuple[int, List[Tuple[int, int, Validator]]]] = []
@@ -663,11 +667,14 @@ def verify_commit_light_trusting_batched(
     needed_list: List[int] = []
     agg_done: dict = {}  # entry position -> result for aggregated commits
     off = 0
-    for pos_e, (val_set, chain_id, commit, trust_level) in enumerate(entries):
+    for pos_e, entry in enumerate(entries):
+        val_set, chain_id, commit, trust_level = entry[:4]
         if _is_aggregated(commit):
+            commit_vals = entry[4] if len(entry) > 4 else None
             try:
                 val_set.verify_commit_light_trusting(chain_id, commit,
-                                                     trust_level)
+                                                     trust_level,
+                                                     commit_vals=commit_vals)
                 agg_done[pos_e] = None
             except Exception as e:
                 agg_done[pos_e] = e
@@ -717,7 +724,7 @@ def verify_commit_light_trusting_batched(
         if pre_err is not None:
             results.append(pre_err)
             continue
-        _vs, _chain, commit, _tl = entry
+        commit = entry[2]
         tallied = 0
         seen: dict = {}
         err: Optional[Exception] = None

@@ -290,6 +290,164 @@ def test_param_off_artifacts_are_byte_identical():
     assert type(rt) is Commit
 
 
+def test_validator_update_pop_gate_rogue_key_regression():
+    """The genesis PoP gate must also cover keys entering via ABCI validator
+    updates (EndBlock/InitChain): on an aggregated chain with a dynamic
+    validator set, an unchecked admission is exactly the rogue-key attack
+    surface — pk* - sum(honest pks) would forge fast-aggregate commits."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.state.execution import validate_validator_updates
+
+    params = ConsensusParams(validator=ValidatorParams(["bls12381"]),
+                             signature=SignatureParams("bls12381", True))
+    try:
+        k1 = crypto.Bls12381PrivKey.generate(b"upd" + b"\x01" * 4)
+        k2 = crypto.Bls12381PrivKey.generate(b"upd" + b"\x02" * 4)
+
+        validate_validator_updates(
+            [abci.ValidatorUpdate("bls12381", k1.pub_key().bytes(), 10,
+                                  pop=k1.pop())], params)
+        assert bls.is_registered(k1.pub_key().bytes())
+
+        # no pop → refused, never registered
+        with pytest.raises(ValueError, match="proof of possession"):
+            validate_validator_updates(
+                [abci.ValidatorUpdate("bls12381", k2.pub_key().bytes(), 10)],
+                params)
+        # a pop lifted from ANOTHER key must not stand in
+        with pytest.raises(ValueError, match="proof of possession"):
+            validate_validator_updates(
+                [abci.ValidatorUpdate("bls12381", k2.pub_key().bytes(), 10,
+                                      pop=k1.pop())], params)
+        assert not bls.is_registered(k2.pub_key().bytes())
+
+        # deletion (power 0) needs no pop
+        validate_validator_updates(
+            [abci.ValidatorUpdate("bls12381", k2.pub_key().bytes(), 0)],
+            params)
+
+        # an already-registered key STILL needs its pop on later updates:
+        # the verdict must not depend on in-process registration state
+        # (a restarted node has an empty set and must agree)
+        with pytest.raises(ValueError, match="proof of possession"):
+            validate_validator_updates(
+                [abci.ValidatorUpdate("bls12381", k1.pub_key().bytes(), 20)],
+                params)
+        validate_validator_updates(
+            [abci.ValidatorUpdate("bls12381", k1.pub_key().bytes(), 20,
+                                  pop=k1.pop())], params)
+    finally:
+        bls.reset()
+
+
+def test_validator_update_pop_wire_roundtrip():
+    """The pop field survives the ABCI proto codec (ResponseEndBlock)."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.proto_codec import decode_response, encode_response
+    from tendermint_tpu.libs import protowire as pw
+
+    k = crypto.Bls12381PrivKey.generate(b"wire" + b"\x03" * 4)
+    resp = abci.ResponseEndBlock(validator_updates=[
+        abci.ValidatorUpdate("bls12381", k.pub_key().bytes(), 7, pop=k.pop()),
+        abci.ValidatorUpdate("ed25519", b"\x11" * 32, 3),  # pop absent
+    ])
+    frame, _ = pw.read_length_delimited(encode_response("end_block", resp))
+    _method, rt = decode_response(frame)
+    assert rt.validator_updates[0].pop == k.pop()
+    assert rt.validator_updates[0].pub_key_bytes == k.pub_key().bytes()
+    assert rt.validator_updates[1].pop == b""
+
+
+def test_aggregated_commit_time_window():
+    """timestamp_ns is covered by no signature, so each validator bounds it
+    subjectively before prevoting (consensus.state.check_aggregated_commit_time):
+    within drift of its own recorded precommit times, never ahead of the
+    local clock by more than drift."""
+    from tendermint_tpu.consensus.state import check_aggregated_commit_time
+
+    now = 1_700_000_000_000_000_000
+    drift = 10_000_000_000  # 10s
+    commit = AggregatedCommit(HEIGHT, 0, BID, [], signers=BitArray(N),
+                              agg_sig=b"\x01" * 48, timestamp_ns=now)
+
+    # in-window vs recorded precommit times
+    seen = [now - 2_000_000_000, now, now + 1_000_000_000]
+    check_aggregated_commit_time(commit, seen, now, drift)
+    # no recorded votes (catching up): only the clock bound applies
+    check_aggregated_commit_time(commit, [], now, drift)
+
+    # proposer-invented future time: beyond clock drift
+    commit.timestamp_ns = now + drift + 1
+    with pytest.raises(ValueError, match="ahead of local time"):
+        check_aggregated_commit_time(commit, seen, now, drift)
+
+    # inside clock drift but outside the recorded-precommit window
+    commit.timestamp_ns = now + drift - 1
+    with pytest.raises(ValueError, match="outside the window"):
+        check_aggregated_commit_time(commit, [now - 30_000_000_000], now, drift)
+    # ... and a past time far below anything we saw is refused too
+    commit.timestamp_ns = now - 60_000_000_000
+    with pytest.raises(ValueError, match="outside the window"):
+        check_aggregated_commit_time(commit, seen, now, drift)
+
+
+def test_trusting_batched_aggregated_commit_vals_across_valset_change():
+    """Aggregated entries of verify_commit_light_trusting_batched may carry
+    the commit-height validator set as a 5th tuple element: whenever the
+    trusted set differs from the commit's signer bitmap (any valset change
+    between trusted and commit height) the pairing needs THAT set, exactly
+    like the non-batched path with commit_vals (light/verifier.py
+    verify_non_adjacent)."""
+    from tendermint_tpu.types.canonical import vote_sign_bytes as vsb
+    from tendermint_tpu.types.errors import ErrInvalidCommitSignatures
+    from tendermint_tpu.types.validator_set import (
+        verify_commit_light_trusting_batched,
+    )
+
+    try:
+        trust = (1, 3)
+        pks = [crypto.Bls12381PrivKey.generate(b"lbat" + bytes([i]) * 4)
+               for i in range(5)]
+        commit_vals = ValidatorSet([
+            Validator(k.pub_key().address(), k.pub_key(), 10) for k in pks])
+        msg = vsb("agg-batched", SignedMsgType.PRECOMMIT, HEIGHT, 0, BID, 0)
+        signers = BitArray(5)
+        for i in range(5):
+            signers.set_index(i, True)
+        commit = AggregatedCommit(
+            HEIGHT, 0, BID, [], signers=signers,
+            agg_sig=bls.aggregate([k.sign(msg) for k in pks]),
+            timestamp_ns=1_700_000_000_000_000_000)
+
+        # trusted set = commit set minus one validator: a different size,
+        # the shape every bisection step with a valset change produces
+        trusted = ValidatorSet([
+            Validator(k.pub_key().address(), k.pub_key(), 10)
+            for k in pks[:4]])
+
+        # plain ed25519 entry rides the same batch, unaffected
+        ed = Rig("agg-batched-ed", "ed25519")
+        ed_commit = ed.make_commit(set(range(N)))
+
+        results = verify_commit_light_trusting_batched([
+            (trusted, "agg-batched", commit, trust, commit_vals),
+            (ed.val_set, ed.chain_id, ed_commit, trust),
+            (trusted, "agg-batched", commit, trust),  # no commit_vals: size mismatch
+        ])
+        assert results[0] is None
+        assert results[1] is None
+        assert isinstance(results[2], ErrInvalidCommitSignatures)
+
+        # exact parity with the sequential path, both ways
+        trusted.verify_commit_light_trusting("agg-batched", commit, trust,
+                                             commit_vals=commit_vals)
+        with pytest.raises(ErrInvalidCommitSignatures):
+            trusted.verify_commit_light_trusting("agg-batched", commit, trust)
+    finally:
+        schemes.reset()
+        bls.reset()
+
+
 def test_genesis_pop_gate_rogue_key_regression():
     """A BLS validator enters genesis only with a proof of possession for
     ITS key: a missing pop, a replayed pop, and a wrong-scheme key must all
