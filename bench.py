@@ -1394,10 +1394,23 @@ def bench_aggsig():
     sign-bytes memo for ed25519, warm decompression/apk caches for BLS),
     which is what the consensus hot loop and light client replay pay per
     height once a validator set is live.  vs_baseline on the BLS rows is
-    the A/B ratio against the ed25519-batched rate at the same scale."""
-    from tendermint_tpu.crypto import schemes
+    the A/B ratio against the ed25519-batched rate at the same scale.
 
+    Two extensions ride along: the blocksync fast-sync replay A/B (a
+    window of contiguous commits through verify_commit_light_batched per
+    scheme — the stage-A dispatch path fast sync actually runs, closing
+    the ROADMAP aggsig edge) and the BLS plane telemetry captured through
+    a run-local DeviceMetrics (pairing wall time, aggregate-verify counts
+    by mode, aggregated-commit wire-size observations)."""
+    from tendermint_tpu.crypto import phases, schemes
+    from tendermint_tpu.libs.metrics import DeviceMetrics, Registry
+    from tendermint_tpu.types.validator_set import verify_commit_light_batched
+
+    dm = DeviceMetrics(Registry("bench_aggsig"))
+    prev_metrics = phases.metrics
+    phases.set_device_metrics(dm)
     sizes = {}
+    replay_window = 8
     try:
         for n_vals in (150, 1000):
             ed_chain = f"aggsig-ed-{n_vals}"
@@ -1419,7 +1432,39 @@ def bench_aggsig():
                   bls_rate, "commits/s", bls_rate / ed_rate, n_vals=n_vals)
             sizes[n_vals] = (len(commit_ed.encode()),
                              len(commit_bls.encode()))
+
+        # -- blocksync fast-sync replay A/B (ROADMAP aggsig edge) ---------
+        # the replay regime: a window of contiguous commits verified in
+        # ONE verify_commit_light_batched call, exactly what the blocksync
+        # reactor's stage-A dispatch pays per window. Ed25519 entries fold
+        # into one device batch; aggregated commits verify inline, one
+        # pairing each.
+        def _replay(entries):
+            errs = [e for e in verify_commit_light_batched(entries)
+                    if e is not None]
+            if errs:
+                raise errs[0]
+
+        bs_ed_chain = "aggsig-replay-ed-150"
+        vs_e, commit_e, bid_e = _mk_ed25519_commit_local(150, bs_ed_chain)
+        ed_entries = [(vs_e, bs_ed_chain, bid_e, 100, commit_e)
+                      for _ in range(replay_window)]
+        best = _timed(lambda: _replay(ed_entries), warm=2, runs=3)
+        ed_replay_rate = replay_window / best
+        _emit("blocksync_replay_150val_ed25519_commits_per_sec",
+              ed_replay_rate, "commits/s", 1.0, window=replay_window)
+
+        bs_bls_chain = "aggsig-replay-bls-150"
+        vs_b, commit_b, bid_b = _mk_bls_aggregated_commit(150, bs_bls_chain)
+        bls_entries = [(vs_b, bs_bls_chain, bid_b, 100, commit_b)
+                       for _ in range(replay_window)]
+        best = _timed(lambda: _replay(bls_entries), warm=2, runs=3)
+        bls_replay_rate = replay_window / best
+        _emit("blocksync_replay_150val_bls_commits_per_sec",
+              bls_replay_rate, "commits/s", bls_replay_rate / ed_replay_rate,
+              window=replay_window)
     finally:
+        phases.set_device_metrics(prev_metrics)
         schemes.reset()
     # informational: the wire-size collapse (48 B sig + signer bitmap +
     # fixed header vs n_vals CommitSig entries) — never gated
@@ -1428,6 +1473,75 @@ def bench_aggsig():
           ed25519_commit_bytes=ed_b,
           agg_sig_bytes=48,
           compression_ratio=round(ed_b / agg_b, 1))
+    # informational: the BLS plane telemetry the run just exercised,
+    # read back through the run-local DeviceMetrics — pairing wall cost,
+    # verify counts split by mode (full from the A/B, light from the
+    # replay), and how many wire-size observations landed. Never gated.
+    pair_calls = sum(dm.pairing_seconds._totals.values())
+    pair_wall = sum(dm.pairing_seconds._sums.values())
+    verify_by_mode = {"|".join(k): int(v) for k, v in
+                      sorted(dm.aggregate_verify_total._values.items())}
+    _emit("aggsig_pairing_telemetry", float(pair_calls), "calls", 0.0,
+          pairing_wall_s_total=round(pair_wall, 6),
+          pairing_wall_s_mean=round(pair_wall / pair_calls, 6)
+          if pair_calls else 0.0,
+          aggregate_verify_total=verify_by_mode,
+          wire_size_observations=sum(
+              dm.aggregated_commit_bytes._totals.values()))
+
+
+def bench_soak():
+    """Config soak: compressed in-proc game day (tools/soak.py). A 6-node
+    fleet (4 validators + 2 fulls) under continuous open-loop signed load
+    with corruption, churn and a crash-kill armed concurrently from one
+    seed, judged against the default SLOSpec. Gated rows: SLO breach
+    count (lower-better "breaches" unit), commit p99, and kill->caught-up
+    recovery. Roughly a minute of chaos plus fleet spin-up/teardown; the
+    full 8-node / 5-minute game day stays in tools/soak.py --ci."""
+    import tempfile
+
+    soak = _tools_mod("soak")
+    try:
+        out = os.path.join(tempfile.mkdtemp(prefix="bench_soak_"),
+                           "soak_report.json")
+        rep = soak.run_soak(n_nodes=6, seed=1, duration_s=60.0, out=out)
+        sl = rep["slo"]
+        _emit("inproc_soak_slo_breaches", float(len(sl["breaches"])),
+              "breaches", 0.0, seed=rep["seed"], n_nodes=rep["n_nodes"],
+              duration_s=rep["duration_s"],
+              unattributed=sl["unattributed"],
+              breach_planes=sorted({b["attribution"]["plane"]
+                                    for b in sl["breaches"]}),
+              schedule_fingerprint=rep["schedule_fingerprint"],
+              breach_fingerprint=rep["breach_fingerprint"],
+              heights=rep["heights"], event_errors=rep["event_errors"],
+              report_path=out)
+        obs = rep["observed"]
+        if obs["commit_samples"]:
+            _emit("inproc_soak_commit_p99_s", float(obs["commit_p99_s"]),
+                  "s", 0.0, commit_samples=obs["commit_samples"],
+                  rate_txs_per_s=rep["load"]["rate_txs_per_s"],
+                  sent=rep["load"]["sent"])
+        else:
+            _emit("inproc_soak_commit_p99_s", 0.0, "error", 0.0,
+                  error="no commit latency samples observed")
+        recoveries = [k["kill_to_caughtup_s"] for k in rep["kills"]
+                      if k.get("kill_to_caughtup_s") is not None]
+        if recoveries:
+            _emit("inproc_soak_kill_caughtup_s", float(max(recoveries)),
+                  "s", 0.0, kills=len(rep["kills"]),
+                  churn_caughtup_s=[round(j["caughtup_s"], 2)
+                                    for j in rep["joins"]])
+        else:
+            # a kill that armed but never fired (or never rejoined) is a
+            # regression the gate must see, not a silently missing row
+            _emit("inproc_soak_kill_caughtup_s", 0.0, "error", 0.0,
+                  error="no completed kill->rejoin cycle",
+                  kills=rep["kills"], event_errors=rep["event_errors"])
+    except Exception as e:
+        for m in ("inproc_soak_slo_breaches", "inproc_soak_commit_p99_s",
+                  "inproc_soak_kill_caughtup_s"):
+            _emit(m, 0.0, "error", 0.0, error=f"{type(e).__name__}: {e}")
 
 
 CONFIGS = {
@@ -1442,6 +1556,7 @@ CONFIGS = {
     "crash": bench_crash,
     "exec": bench_exec,
     "aggsig": bench_aggsig,
+    "soak": bench_soak,
     "10k": bench_verify_commit_10k,
 }
 
@@ -1488,7 +1603,7 @@ if __name__ == "__main__":
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
             for key in ("2", "3", "4", "ingest", "churn", "crash", "exec",
-                        "aggsig", "5", "1", "multichip", "10k"):
+                        "aggsig", "soak", "5", "1", "multichip", "10k"):
                 for attempt in (1, 2):
                     try:
                         with _tracer.span(f"config_{key}"):

@@ -377,11 +377,42 @@ def _pairing_verdict(apk, msg: bytes, sig: bytes, dst: bytes) -> bool:
 
 def fast_aggregate_verify_routed(pks, msg: bytes, sig: bytes,
                                  dst: bytes = DST_SIG,
-                                 backend=None) -> bool:
+                                 backend=None, mode: str = "full") -> bool:
     """fast_aggregate_verify with backend routing.  The jax backend is the
     device path: breaker-gated, chaos-injectable at `crypto.bls_verify`,
     phase-recorded; any failure falls back to the host scalar engine with
-    an identical verdict."""
+    an identical verdict.
+
+    ``mode`` labels which verify_commit* entry point asked (full / light /
+    trusting) — it never changes the verdict, only the telemetry: the call
+    is timed into ``crypto_pairing_seconds{plane}``, counted into
+    ``crypto_aggregate_verify_total{scheme,mode}``, and wrapped in a
+    height-tagged ``agg_verify`` tracer span so trace_merge/stage
+    breakdowns can split ed25519 vs bls12381 commits."""
+    import time as _time
+
+    from ...libs.trace import tracer
+
+    plane, height = _phases.context()
+    span_args = {"scheme": "bls12381", "mode": mode, "n_signers": len(pks)}
+    if height is not None:
+        span_args["height"] = height
+    t0 = _time.perf_counter()
+    try:
+        with tracer.span("agg_verify", **span_args):
+            return _routed(pks, msg, sig, dst, backend)
+    finally:
+        m = _phases.metrics
+        if m is not None:
+            try:
+                m.pairing_seconds.labels(plane or "aggsig").observe(
+                    _time.perf_counter() - t0)
+                m.aggregate_verify_total.labels("bls12381", mode).inc()
+            except Exception:
+                pass
+
+
+def _routed(pks, msg: bytes, sig: bytes, dst: bytes, backend) -> bool:
     from . import fast_aggregate_verify  # scalar reference path
 
     if backend is None:

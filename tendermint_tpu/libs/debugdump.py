@@ -224,6 +224,18 @@ def write_dump(out_dir: str, node=None, loop=None) -> str:
     except Exception:
         traceback.print_exc(file=sys.stderr)
 
+    # soak report, when this dump fires during a game-day run (tools/
+    # soak.py exports TMTPU_SOAK_REPORT and rewrites the file per SLO
+    # evaluation): the chaos schedule + breach attributions in flight
+    try:
+        soak = os.environ.get("TMTPU_SOAK_REPORT")
+        if soak and os.path.exists(soak):
+            import shutil
+
+            shutil.copy(soak, os.path.join(out_dir, "soak_report.json"))
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
     if node is not None:
         with open(os.path.join(out_dir, "node_state.txt"), "w") as f:
             try:

@@ -603,6 +603,55 @@ class DeviceMetrics:
         self.device_inflight = g(
             "crypto", "device_inflight",
             "Segments currently in flight per device.", ["device"])
+        # -- aggregate-signature (BLS) plane telemetry --------------------
+        # PR 17 made commits collapse to one pairing; these series make
+        # that pairing visible: wall cost per call, calls per verify mode
+        # (full / light / trusting — the three verify_commit* entries),
+        # and the wire size the aggregation bought.
+        self.pairing_seconds = h(
+            "crypto", "pairing_seconds",
+            "Wall seconds per aggregate-signature verify call (pack + "
+            "subgroup checks + the one pairing), by crypto plane.",
+            ["plane"], buckets=self.PHASE_BUCKETS)
+        self.aggregate_verify_total = c(
+            "crypto", "aggregate_verify_total",
+            "Aggregate-signature verifications by scheme and verify mode "
+            "(full/light/trusting).", ["scheme", "mode"])
+        self.aggregated_commit_bytes = h(
+            "crypto", "aggregated_commit_bytes",
+            "Encoded wire size of verified aggregated commits (48-byte "
+            "agg sig + signer bitmap + overhead; an ed25519 commit at the "
+            "same validator count is ~100 B/signer).",
+            buckets=(64, 96, 128, 192, 256, 384, 512, 1024, 4096, 16384))
+
+
+class ProcessMetrics:
+    """Process resource watermarks (libs/watermark.py sampler): the
+    slow-leak surface. Sampled right before each /metrics render, so
+    FleetScraper sees fresh values and the soak plane's leak-slope SLOs
+    (bounded RSS/WAL/ring growth, bounded series cardinality) have a
+    stream to judge."""
+
+    def __init__(self, reg: Registry):
+        g = reg.gauge
+        self.rss_bytes = g(
+            "process", "rss_bytes",
+            "Resident set size of this process in bytes.")
+        self.open_fds = g(
+            "process", "open_fds",
+            "Open file descriptors held by this process.")
+        self.wal_bytes = g(
+            "process", "wal_bytes",
+            "On-disk bytes of this node's WALs including rotated "
+            "segments.")
+        self.txlife_ring_depth = g(
+            "process", "txlife_ring_depth",
+            "Sealed tx-lifecycle records currently held in the bounded "
+            "ring.")
+        self.metric_series = g(
+            "process", "metric_series",
+            "Rendered series cardinality of this node's own metric "
+            "registry (label-set blowups show up here first).")
 
 
 class FaultMetrics:
@@ -752,6 +801,7 @@ class NodeMetrics:
         self.statesync = StateSyncMetrics(self.registry)
         self.faults = FaultMetrics(self.registry)
         self.recovery = RecoveryMetrics(self.registry)
+        self.process = ProcessMetrics(self.registry)
         # tracer ring saturation (libs/trace.py): a bounded ring that
         # silently ate its front reads as "nothing happened early on" —
         # this series (plus the export header's `dropped`) says otherwise

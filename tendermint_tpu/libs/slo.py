@@ -1,0 +1,377 @@
+"""Declarative SLO engine: objectives over sliding windows, with breach
+attribution against a seeded chaos schedule.
+
+The chaos planes (faults, corruption, churn, crash, backpressure) assert
+*invariants* — nothing forked, nothing double-signed. This module renders
+the other judgment: did the fleet keep its *service levels* while all of
+that was happening? An :class:`SLOSpec` declares objectives in a tiny
+line grammar::
+
+    # stream    agg    op  threshold   [window=SECONDS]
+    commit_latency p99 <= 5.0 window=30
+    caughtup       max <= 120
+    rss_bytes      slope <= 8388608
+
+Streams are plain named time series fed sample-by-sample into an
+:class:`SLOEngine` (``feed(stream, t, value, node=...)``) from whatever
+the caller already has — FleetScraper rollups, txlife sealed records,
+stage-timeline deltas, watermark samples. ``evaluate()`` slides each
+objective's window (hop = window/2) over every per-node series and emits
+merged breach intervals.
+
+Every breach is then *attributed*: :func:`attribute` intersects the
+breach window with the chaos schedule (which plane was armed, which node
+was dying, which links were black-holed) and with the slowest-stage
+timeline, so an SLO miss names a plane, a node and a stage.
+``unattributed`` is a loud first-class outcome, not a fallback: a breach
+that overlaps no armed chaos window is exactly how slow leaks and
+metric-cardinality blowups surface.
+
+Fingerprints (:func:`breach_fingerprint`) strip wall-clock fields so two
+same-seed runs can be diffed (tools/soak.py --verify-determinism).
+
+Stdlib-only on purpose: tools/soak.py --self-test runs this on boxes
+that can't import jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+
+AGGS = ("p50", "p90", "p99", "max", "min", "mean", "count", "slope", "last")
+OPS = ("<=", ">=", "==", "<", ">")  # longest-match order for the parser
+
+#: the soak plane's standard objectives (thresholds sized for an in-proc
+#: fleet under concurrent multi-plane chaos on a shared CPU — generous on
+#: latency, tight on "should never happen" counters and growth slopes).
+DEFAULT_SPEC = """\
+# stream            agg    op  threshold  window
+commit_latency      p99    <=  20.0       window=30
+caughtup            max    <=  120
+queue_full_sheds    count  <=  0
+rss_bytes           slope  <=  8388608
+wal_bytes           slope  <=  4194304
+ring_depth          max    <=  4096
+metric_series       max    <=  8000
+"""
+
+
+class Objective:
+    """One parsed spec line. ``window_s <= 0`` means whole-run."""
+
+    __slots__ = ("stream", "agg", "op", "threshold", "window_s", "name")
+
+    def __init__(self, stream: str, agg: str, op: str, threshold: float,
+                 window_s: float = 0.0):
+        if agg not in AGGS:
+            raise ValueError(f"unknown aggregator {agg!r} (one of {AGGS})")
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r} (one of {OPS})")
+        self.stream = stream
+        self.agg = agg
+        self.op = op
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.name = f"{stream}_{agg}"
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "stream": self.stream, "agg": self.agg,
+                "op": self.op, "threshold": self.threshold,
+                "window_s": self.window_s}
+
+
+class SLOSpec:
+    """A parsed set of objectives."""
+
+    def __init__(self, objectives: List[Objective]):
+        self.objectives = objectives
+
+    @classmethod
+    def parse(cls, text: str) -> "SLOSpec":
+        """Line grammar: ``<stream> <agg> <op> <value> [window=N]`` with
+        ``#`` comments and blank lines ignored. Raises ValueError with
+        the offending line number on any malformed line."""
+        objectives = []
+        for lineno, raw in enumerate(text.splitlines(), 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) not in (4, 5):
+                raise ValueError(f"spec line {lineno}: expected "
+                                 f"'<stream> <agg> <op> <value> "
+                                 f"[window=N]', got {raw!r}")
+            stream, agg, op, value = parts[:4]
+            window_s = 0.0
+            if len(parts) == 5:
+                if not parts[4].startswith("window="):
+                    raise ValueError(
+                        f"spec line {lineno}: trailing field must be "
+                        f"window=N, got {parts[4]!r}")
+                window_s = float(parts[4][len("window="):].rstrip("s"))
+            try:
+                threshold = float(value)
+            except ValueError:
+                raise ValueError(
+                    f"spec line {lineno}: bad threshold {value!r}")
+            try:
+                objectives.append(
+                    Objective(stream, agg, op, threshold, window_s))
+            except ValueError as e:
+                raise ValueError(f"spec line {lineno}: {e}")
+        return cls(objectives)
+
+    @classmethod
+    def default(cls) -> "SLOSpec":
+        return cls.parse(DEFAULT_SPEC)
+
+    def as_dicts(self) -> List[dict]:
+        return [o.as_dict() for o in self.objectives]
+
+
+# -- aggregation --------------------------------------------------------------
+
+def _percentile(vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (matches tools/loadtime.py)."""
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    k = max(0, min(len(s) - 1, int(round(q / 100.0 * len(s) + 0.5)) - 1))
+    return s[k]
+
+
+def _aggregate(pts: List[Tuple[float, float]], agg: str) -> float:
+    """Reduce [(t, value), ...] (already window-filtered, time-sorted)."""
+    vals = [v for _, v in pts]
+    if agg == "count":
+        return float(sum(vals))          # feed event deltas as values
+    if not vals:
+        return 0.0
+    if agg == "max":
+        return max(vals)
+    if agg == "min":
+        return min(vals)
+    if agg == "mean":
+        return sum(vals) / len(vals)
+    if agg == "last":
+        return vals[-1]
+    if agg == "slope":
+        dt = pts[-1][0] - pts[0][0]
+        if dt <= 0:
+            return 0.0
+        # growth rate clamped at zero: gauges legitimately dip (GC, WAL
+        # rotation) and the leak objective only cares about net growth
+        return max(0.0, (vals[-1] - vals[0]) / dt)
+    return _percentile(vals, {"p50": 50.0, "p90": 90.0, "p99": 99.0}[agg])
+
+
+def _violates(observed: float, op: str, threshold: float) -> bool:
+    if op == "<=":
+        return observed > threshold
+    if op == "<":
+        return observed >= threshold
+    if op == ">=":
+        return observed < threshold
+    if op == ">":
+        return observed <= threshold
+    return observed != threshold         # "=="
+
+
+def _worse(a: float, b: float, op: str) -> float:
+    """Of two breaching observations, the one further past the bound."""
+    return max(a, b) if op in ("<=", "<") else min(a, b)
+
+
+# -- the engine ---------------------------------------------------------------
+
+class SLOEngine:
+    """Feed streams, evaluate objectives over sliding windows.
+
+    Samples are (t, value, node) triples; ``node=None`` means
+    cluster-level. Evaluation is pure over the fed samples — same
+    streams in, same breaches out — which is what makes same-seed soak
+    runs diffable by fingerprint."""
+
+    MAX_WINDOWS = 100_000   # runaway-spec backstop, not a tuning knob
+
+    def __init__(self, spec: Optional[SLOSpec] = None):
+        self.spec = spec or SLOSpec.default()
+        self._streams: Dict[str, List[Tuple[float, float, Optional[str]]]] = {}
+
+    def feed(self, stream: str, t: float, value: float,
+             node: Optional[str] = None) -> None:
+        self._streams.setdefault(stream, []).append(
+            (float(t), float(value), node))
+
+    def feed_many(self, stream: str,
+                  samples: List[Tuple[float, float]],
+                  node: Optional[str] = None) -> None:
+        for t, v in samples:
+            self.feed(stream, t, v, node)
+
+    def sample_counts(self) -> Dict[str, int]:
+        return {k: len(v) for k, v in sorted(self._streams.items())}
+
+    def _windows(self, obj: Objective,
+                 pts: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+        t0, t1 = pts[0][0], pts[-1][0]
+        if obj.window_s <= 0 or t1 - t0 <= obj.window_s:
+            return [(t0, t1)]
+        hop = obj.window_s / 2.0
+        out, start, n = [], t0, 0
+        while start < t1 and n < self.MAX_WINDOWS:
+            out.append((start, start + obj.window_s))
+            start += hop
+            n += 1
+        return out
+
+    def evaluate(self) -> List[dict]:
+        """All breaches, per objective per node, with consecutive
+        breaching windows merged into one interval carrying the worst
+        observation."""
+        breaches: List[dict] = []
+        for obj in self.spec.objectives:
+            samples = self._streams.get(obj.stream, [])
+            if not samples:
+                continue
+            groups: Dict[str, List[Tuple[float, float]]] = {}
+            for t, v, node in samples:
+                groups.setdefault(node or "cluster", []).append((t, v))
+            for node in sorted(groups):
+                pts = sorted(groups[node])
+                run: Optional[dict] = None
+                for w0, w1 in self._windows(obj, pts):
+                    sel = [(t, v) for t, v in pts if w0 <= t <= w1]
+                    if not sel:
+                        continue
+                    observed = _aggregate(sel, obj.agg)
+                    if _violates(observed, obj.op, obj.threshold):
+                        if run is not None and w0 <= run["window"][1]:
+                            run["window"][1] = w1
+                            run["observed"] = _worse(
+                                run["observed"], observed, obj.op)
+                        else:
+                            if run is not None:
+                                breaches.append(run)
+                            run = {"objective": obj.name,
+                                   "stream": obj.stream, "agg": obj.agg,
+                                   "op": obj.op,
+                                   "threshold": obj.threshold,
+                                   "observed": round(observed, 6),
+                                   "window": [w0, w1], "node": node}
+                    elif run is not None:
+                        breaches.append(run)
+                        run = None
+                if run is not None:
+                    breaches.append(run)
+        for b in breaches:
+            b["observed"] = round(b["observed"], 6)
+        return breaches
+
+
+# -- attribution --------------------------------------------------------------
+
+def _overlap(a0: float, a1: float, b0: float, b1: float) -> float:
+    return max(0.0, min(a1, b1) - max(a0, b0))
+
+
+def attribute(breach: dict, schedule: List[dict],
+              stages: Optional[List[dict]] = None,
+              min_cover: float = 1.0 / 3.0,
+              total_span: Optional[float] = None) -> dict:
+    """Name the plane/node/stage behind a breach, or say ``unattributed``
+    out loud.
+
+    ``schedule`` entries are armed chaos windows
+    ``{"t0", "t1", "plane", "node"?, "detail"?}`` on the same clock as
+    the breach window. Selection, in order:
+
+    1. A breach spanning (>= 90% of) ``total_span`` — the whole run —
+       is *global*, and a time-localized chaos window can't explain a
+       global symptom: slow leaks and cardinality blowups stay loudly
+       unattributed instead of pinned on whichever plane happened to be
+       armed longest.
+    2. Candidate events must cover at least ``min_cover`` of the breach
+       window (sliding-window aggregates like p99 smear a spike by up to
+       one window on each side, so the bound is deliberately looser than
+       a majority). A zero-length breach — a single-point stream like a
+       kill-to-caught-up measurement — qualifies any window containing
+       it.
+    3. Among qualifiers, the most *concentrated* wins — largest
+       overlap-to-event-duration ratio, ties to the shorter event. When
+       planes are armed concurrently (the whole point of a game day) a
+       nested, more specific window beats the broad one above it.
+
+    ``stages`` entries are slowest-stage records ``{"t0", "t1",
+    "stage"}`` from the merged trace/stage-timeline machinery."""
+    w0, w1 = breach["window"]
+    span = max(0.0, w1 - w0)
+    best = None
+    if total_span is None or span < 0.9 * total_span:
+        best_key = None
+        for ev in schedule or ():
+            e0, e1 = ev["t0"], ev["t1"]
+            elen = max(e1 - e0, 1e-9)
+            if span <= 0:
+                if not (e0 <= w0 <= e1):
+                    continue
+                ov = 1e-9
+            else:
+                ov = _overlap(w0, w1, e0, e1)
+                if e1 <= e0 and w0 <= e0 <= w1:
+                    ov = max(ov, 1e-9)
+                if ov < min_cover * span:
+                    continue
+            key = (ov / elen, -elen)
+            if best_key is None or key > best_key:
+                best, best_key = ev, key
+    stage = "unknown"
+    if stages:
+        sbest, sov = None, 0.0
+        for s in stages:
+            ov = _overlap(w0, w1, s["t0"], s["t1"])
+            if ov > sov:
+                sbest, sov = s, ov
+        if sbest is not None:
+            stage = sbest["stage"]
+    if best is None:
+        return {"plane": "unattributed",
+                "node": breach.get("node") or "cluster",
+                "stage": stage, "detail": ""}
+    return {"plane": best["plane"],
+            "node": best.get("node") or breach.get("node") or "cluster",
+            "stage": stage, "detail": best.get("detail", "")}
+
+
+def attribute_all(breaches: List[dict], schedule: List[dict],
+                  stages: Optional[List[dict]] = None,
+                  total_span: Optional[float] = None) -> List[dict]:
+    """Annotate every breach in place with its attribution; returns the
+    list for chaining."""
+    for b in breaches:
+        b["attribution"] = attribute(b, schedule, stages,
+                                     total_span=total_span)
+    return breaches
+
+
+# -- fingerprints -------------------------------------------------------------
+
+def breach_fingerprint(breaches: List[dict]) -> str:
+    """Wall-clock-stripped digest of WHAT breached and WHY — objective,
+    node, plane, stage — so two same-seed runs diff clean even though
+    their window timestamps and observed values never replay exactly."""
+    keys = sorted(
+        (b["objective"], b.get("node") or "cluster",
+         (b.get("attribution") or {}).get("plane", "unattributed"),
+         (b.get("attribution") or {}).get("stage", "unknown"))
+        for b in breaches)
+    blob = json.dumps(keys, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def schedule_fingerprint(plan: List[dict]) -> str:
+    """Digest of a chaos schedule (offset-timestamped, so pure per seed)."""
+    blob = json.dumps(plan, sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]

@@ -1,0 +1,133 @@
+"""Process resource watermarks: RSS, open fds, WAL bytes, txlife ring
+depth, metric-series cardinality — the slow-leak surface.
+
+Every other plane measures *throughput*; nothing measured *growth*. A
+WAL that never prunes, a sealed-ring that stops evicting, or a metric
+registry whose label sets multiply are invisible to invariant checks and
+to p99 latency until the box falls over. The sampler reads each
+watermark on demand (cheap: one /proc read each) and mirrors it into the
+``process_*`` gauges on :class:`~.metrics.ProcessMetrics`, so they ride
+the existing /metrics → FleetScraper → soak-SLO pipeline; the leak-slope
+objectives in libs/slo.py are evaluated over exactly these series.
+
+Pure helpers are module-level so tools can use them without a node.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable
+
+try:
+    _PAGE = os.sysconf("SC_PAGE_SIZE")
+except (AttributeError, ValueError, OSError):
+    _PAGE = 4096
+
+
+def rss_bytes() -> int:
+    """Resident set size. /proc when available, getrusage fallback
+    (ru_maxrss is the high-water mark, close enough for slope)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except Exception:
+        try:
+            import resource
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            return 0
+
+
+def open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except Exception:
+        return 0
+
+
+def wal_bytes(paths: Iterable) -> int:
+    """Total on-disk bytes of the given WAL files including rotated
+    segments (``<path>.N`` — see consensus/wal.py rotation). Entries may
+    be callables returning a path, for WALs that open after wiring."""
+    total = 0
+    for path in paths:
+        if callable(path):
+            try:
+                path = path()
+            except Exception:
+                continue
+        if not path:
+            continue
+        try:
+            if os.path.exists(path):
+                total += os.path.getsize(path)
+            idx = 0
+            while os.path.exists(f"{path}.{idx}"):
+                total += os.path.getsize(f"{path}.{idx}")
+                idx += 1
+        except OSError:
+            continue
+    return total
+
+
+def registry_series(registry) -> int:
+    """Rendered-series cardinality of a metrics Registry: one per live
+    label set for counters/gauges; histograms cost bucket+2 lines plus
+    the +Inf bucket per label set. Reaches into the registry's internals
+    on purpose — rendering the whole exposition to count lines would
+    cost more than every other watermark combined."""
+    n = 0
+    try:
+        for m in list(getattr(registry, "_metrics", ())):
+            if hasattr(m, "_counts"):    # histogram
+                n += len(m._totals) * (len(getattr(m, "buckets", ())) + 3)
+            else:
+                n += len(getattr(m, "_values", ()))
+    except Exception:
+        pass
+    return n
+
+
+class ResourceWatermarks:
+    """Per-node sampler bound to a ProcessMetrics gauge set.
+
+    ``sample()`` reads every watermark and mirrors it into the gauges;
+    the node's /metrics handler calls it right before rendering so every
+    scrape carries fresh values without a background task."""
+
+    def __init__(self, metrics=None, txlife=None,
+                 wal_paths: Iterable = (),
+                 registry=None):
+        self.metrics = metrics
+        self.txlife = txlife
+        self.wal_paths = list(wal_paths)
+        self.registry = registry
+
+    def ring_depth(self) -> int:
+        tl = self.txlife
+        if tl is None:
+            return 0
+        try:
+            return len(tl._ring)
+        except Exception:
+            return 0
+
+    def sample(self) -> dict:
+        vals = {
+            "rss_bytes": float(rss_bytes()),
+            "open_fds": float(open_fds()),
+            "wal_bytes": float(wal_bytes(self.wal_paths)),
+            "ring_depth": float(self.ring_depth()),
+            "metric_series": float(registry_series(self.registry)),
+        }
+        m = self.metrics
+        if m is not None:
+            try:
+                m.rss_bytes.set(vals["rss_bytes"])
+                m.open_fds.set(vals["open_fds"])
+                m.wal_bytes.set(vals["wal_bytes"])
+                m.txlife_ring_depth.set(vals["ring_depth"])
+                m.metric_series.set(vals["metric_series"])
+            except Exception:
+                pass
+        return vals

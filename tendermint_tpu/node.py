@@ -319,6 +319,21 @@ class Node:
         restart_reason = os.environ.get("TMTPU_RESTART_REASON")
         if restart_reason:
             self.metrics.recovery.restarts_total.labels(restart_reason).inc()
+        # resource watermarks (libs/watermark.py): RSS/fds/WAL bytes/
+        # txlife ring depth/series cardinality, sampled right before each
+        # /metrics render — the slow-leak stream the soak plane's
+        # leak-slope SLOs evaluate
+        from .libs.watermark import ResourceWatermarks
+
+        self.watermarks = ResourceWatermarks(
+            self.metrics.process, txlife=self.txlife,
+            wal_paths=[getattr(wal, "path", None),
+                       # MempoolWAL opens lazily (init_mempool_wal) and
+                       # holds no path attr — resolve through its file
+                       lambda: getattr(
+                           getattr(getattr(self.mempool, "_wal", None),
+                                   "_f", None), "name", None)],
+            registry=self.metrics.registry)
 
         # consensus stall watchdog (config.consensus.stall_watchdog_s > 0,
         # or TMTPU_STALL_WATCHDOG_S for subprocess nets — e2e runner sets
@@ -539,6 +554,10 @@ class Node:
 
         async def metrics(request):
             self.metrics.p2p.peers.set(len(self.switch.peers))
+            try:
+                self.watermarks.sample()
+            except Exception:
+                pass
             return web.Response(text=self.metrics.registry.render(),
                                 content_type="text/plain")
 

@@ -41,6 +41,20 @@ def _is_aggregated(commit) -> bool:
     return hasattr(commit, "agg_sig")
 
 
+def _observe_aggregated_wire_size(commit) -> None:
+    """Feed the verified commit's encoded size into the aggregated-commit
+    wire-size histogram (telemetry only; never affects the verdict)."""
+    from ..crypto import phases as _phases
+
+    m = _phases.metrics
+    if m is None:
+        return
+    try:
+        m.aggregated_commit_bytes.observe(float(len(commit.encode())))
+    except Exception:
+        pass
+
+
 def _by_voting_power(v: Validator):
     """Sort key: power desc, address asc (reference types/validator.go ValidatorsByVotingPower)."""
     return (-v.voting_power, v.address)
@@ -373,7 +387,7 @@ class ValidatorSet:
         tallied (validator_set.go:667)."""
         self._check_commit_shape(commit, height, block_id)
         if _is_aggregated(commit):
-            return self._verify_aggregated(chain_id, commit)
+            return self._verify_aggregated(chain_id, commit, mode="full")
         idxs = [i for i, cs in enumerate(commit.signatures) if not cs.absent()]
         ok = self._batch_verify(chain_id, commit, idxs)
         tallied = 0
@@ -394,7 +408,7 @@ class ValidatorSet:
         if _is_aggregated(commit):
             # one pairing over the whole bitmap: there is no cheaper
             # early-exit prefix to stop at
-            return self._verify_aggregated(chain_id, commit)
+            return self._verify_aggregated(chain_id, commit, mode="light")
         idxs = [i for i, cs in enumerate(commit.signatures) if cs.for_block()]
         ok = self._batch_verify(chain_id, commit, idxs, plane="light")
         tallied = 0
@@ -468,7 +482,8 @@ class ValidatorSet:
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
 
-    def _verify_aggregated(self, chain_id: str, commit) -> None:
+    def _verify_aggregated(self, chain_id: str, commit,
+                           mode: str = "full") -> None:
         """One fast-aggregate-verify replaces the per-signature batch: apk
         over the bitmap's pubkeys, pairing against the shared zero-timestamp
         sign-bytes. Error precedence mirrors the scalar replay — shape
@@ -476,10 +491,12 @@ class ValidatorSet:
         (ErrNotEnoughVotingPowerSigned)."""
         from ..crypto.bls12381.vec import fast_aggregate_verify_routed
 
+        _observe_aggregated_wire_size(commit)
         signer_idxs = commit.signers.true_indices()
         pks = [self.validators[i].pub_key.bytes() for i in signer_idxs]
         msg = commit.sign_message(chain_id)
-        if not fast_aggregate_verify_routed(pks, msg, commit.agg_sig):
+        if not fast_aggregate_verify_routed(pks, msg, commit.agg_sig,
+                                            mode=mode):
             raise ErrWrongSignature(-1, commit.agg_sig)
         tallied = sum(self.validators[i].voting_power for i in signer_idxs)
         needed = self.total_voting_power() * 2 // 3
@@ -500,10 +517,12 @@ class ValidatorSet:
             commit_vals = self
         if commit_vals.size() != commit.size():
             raise ErrInvalidCommitSignatures(commit_vals.size(), commit.size())
+        _observe_aggregated_wire_size(commit)
         signer_idxs = commit.signers.true_indices()
         pks = [commit_vals.validators[i].pub_key.bytes() for i in signer_idxs]
         msg = commit.sign_message(chain_id)
-        if not fast_aggregate_verify_routed(pks, msg, commit.agg_sig):
+        if not fast_aggregate_verify_routed(pks, msg, commit.agg_sig,
+                                            mode="trusting"):
             raise ErrWrongSignature(-1, commit.agg_sig)
         addr_idx = self._addr_index()
         tallied = 0

@@ -1,0 +1,191 @@
+"""libs/slo.py unit surface: the spec line grammar, sliding-window
+evaluation (merged breach runs, per-node grouping), attribution against a
+seeded chaos schedule — a named plane/node/stage or the loud first-class
+``unattributed`` — and the wall-clock-stripped fingerprints soak
+determinism diffs rely on."""
+
+import pytest
+
+from tendermint_tpu.libs import slo
+
+
+# -- spec grammar -------------------------------------------------------------
+
+def test_spec_parse_good():
+    spec = slo.SLOSpec.parse(
+        "# comment\n"
+        "commit_latency p99 <= 2.5 window=30\n"
+        "\n"
+        "caughtup max <= 60\n"
+        "rss_bytes slope <= 8388608\n")
+    assert [o.name for o in spec.objectives] == [
+        "commit_latency_p99", "caughtup_max", "rss_bytes_slope"]
+    assert spec.objectives[0].window_s == 30.0
+    assert spec.objectives[1].window_s == 0.0  # whole-run
+    assert spec.as_dicts()[0]["threshold"] == 2.5
+
+
+def test_spec_default_covers_the_soak_objectives():
+    names = {o.name for o in slo.SLOSpec.default().objectives}
+    assert {"commit_latency_p99", "caughtup_max", "queue_full_sheds_count",
+            "rss_bytes_slope", "wal_bytes_slope", "ring_depth_max",
+            "metric_series_max"} == names
+
+
+@pytest.mark.parametrize("bad", [
+    "x p99 <=\n",              # missing threshold
+    "x p42 <= 1\n",            # unknown aggregator
+    "x p99 ~ 1\n",             # unknown op
+    "x p99 <= one\n",          # non-numeric threshold
+    "x p99 <= 1 win=3\n",      # bad trailing field
+])
+def test_spec_parse_rejects_malformed_lines(bad):
+    with pytest.raises(ValueError):
+        slo.SLOSpec.parse(bad)
+
+
+# -- sliding-window evaluation ------------------------------------------------
+
+def _engine(text):
+    return slo.SLOEngine(slo.SLOSpec.parse(text))
+
+
+def test_latency_spike_trips_only_windows_hugging_it():
+    eng = _engine("lat p99 <= 1.0 window=10\n")
+    for t in range(61):
+        eng.feed("lat", float(t), 5.0 if 30 <= t <= 32 else 0.2,
+                 node="val0")
+    breaches = eng.evaluate()
+    assert len(breaches) == 1, breaches
+    b = breaches[0]
+    assert b["objective"] == "lat_p99" and b["node"] == "val0"
+    w0, w1 = b["window"]
+    assert w0 <= 30 and w1 >= 32          # the merged run covers the spike
+    assert w1 - w0 <= 30                  # ...but not the whole hour of data
+    assert b["observed"] == 5.0
+
+
+def test_clean_streams_raise_no_breaches():
+    eng = _engine("lat p99 <= 1.0 window=10\n")
+    for t in range(61):
+        eng.feed("lat", float(t), 0.2, node="val0")
+    assert eng.evaluate() == []
+
+
+def test_count_objective_sums_event_deltas():
+    eng = _engine("sheds count <= 0\n")
+    eng.feed_many("sheds", [(0.0, 0.0), (5.0, 0.0), (10.0, 2.0)],
+                  node="full0")
+    breaches = eng.evaluate()
+    assert len(breaches) == 1
+    assert breaches[0]["observed"] == 2.0
+    assert breaches[0]["node"] == "full0"
+
+
+def test_slope_flags_leaks_and_clamps_shrinkage():
+    eng = _engine("rss slope <= 10\n")
+    eng.feed_many("rss", [(float(t), 1000.0 + 64.0 * t)
+                          for t in range(30)], node="leaky")
+    eng.feed_many("rss", [(float(t), 1000.0) for t in range(30)],
+                  node="flat")
+    eng.feed_many("rss", [(float(t), 1000.0 - 64.0 * t)
+                          for t in range(30)], node="gc")
+    breaches = eng.evaluate()
+    assert [b["node"] for b in breaches] == ["leaky"]
+    assert breaches[0]["observed"] == pytest.approx(64.0)
+
+
+def test_per_node_grouping_keeps_breaches_separate():
+    eng = _engine("lat max <= 1.0 window=10\n")
+    for t in range(21):
+        eng.feed("lat", float(t), 0.2, node="ok")
+        eng.feed("lat", float(t), 9.0, node="sad")
+    nodes = {b["node"] for b in eng.evaluate()}
+    assert nodes == {"sad"}
+
+
+# -- attribution --------------------------------------------------------------
+
+def test_attribution_picks_the_concentrated_window():
+    # two planes armed concurrently: the broad churn window covers the
+    # breach too, but the nested corrupt window is more concentrated
+    schedule = [
+        {"t0": 0.0, "t1": 60.0, "plane": "churn", "node": "full0"},
+        {"t0": 27.0, "t1": 41.0, "plane": "corrupt", "node": None,
+         "detail": "net.corrupt@0.05"},
+    ]
+    att = slo.attribute({"window": [28.0, 40.0], "node": "val1"},
+                        schedule, total_span=120.0)
+    assert att["plane"] == "corrupt"
+    assert att["node"] == "val1"          # breach node wins when ev has none
+    assert att["detail"] == "net.corrupt@0.05"
+
+
+def test_attribution_coverage_gate_rejects_glancing_overlap():
+    # the armed window brushes <1/3 of the breach: loudly unattributed
+    schedule = [{"t0": 0.0, "t1": 31.0, "plane": "corrupt", "node": None}]
+    att = slo.attribute({"window": [30.0, 60.0], "node": "val0"},
+                        schedule, total_span=120.0)
+    assert att["plane"] == "unattributed"
+    assert att["node"] == "val0"
+
+
+def test_attribution_global_breach_stays_unattributed():
+    # a whole-run breach (the leak-slope shape) must not pin on whichever
+    # plane happened to be armed longest
+    schedule = [{"t0": 10.0, "t1": 110.0, "plane": "churn", "node": "full0"}]
+    att = slo.attribute({"window": [0.0, 115.0], "node": "leaky"},
+                        schedule, total_span=120.0)
+    assert att["plane"] == "unattributed"
+
+
+def test_attribution_point_breach_by_containment():
+    # zero-span breach (a kill-to-caught-up point stream): any armed
+    # window containing the instant qualifies
+    schedule = [{"t0": 20.0, "t1": 40.0, "plane": "crash", "node": "full1"}]
+    att = slo.attribute({"window": [25.0, 25.0], "node": "full1"}, schedule)
+    assert att["plane"] == "crash" and att["node"] == "full1"
+
+
+def test_attribution_names_the_slowest_stage():
+    schedule = [{"t0": 20.0, "t1": 40.0, "plane": "partition",
+                 "node": "full0"}]
+    stages = [{"t0": 0.0, "t1": 24.0, "stage": "proposal_received"},
+              {"t0": 24.0, "t1": 40.0, "stage": "precommit_quorum"}]
+    att = slo.attribute({"window": [22.0, 38.0], "node": "val0"},
+                        schedule, stages=stages, total_span=120.0)
+    assert att["plane"] == "partition"
+    assert att["stage"] == "precommit_quorum"   # 14 s overlap beats 2 s
+
+
+def test_attribute_all_annotates_in_place():
+    breaches = [{"objective": "lat_p99", "window": [10.0, 20.0],
+                 "node": "val0"}]
+    out = slo.attribute_all(breaches, [], total_span=60.0)
+    assert out is breaches
+    assert breaches[0]["attribution"]["plane"] == "unattributed"
+
+
+# -- fingerprints -------------------------------------------------------------
+
+def test_breach_fingerprint_strips_wall_clock():
+    def mk(w0, w1, observed):
+        return {"objective": "lat_p99", "node": "val0",
+                "window": [w0, w1], "observed": observed,
+                "attribution": {"plane": "corrupt", "stage": "unknown"}}
+    assert (slo.breach_fingerprint([mk(10.0, 20.0, 5.1)])
+            == slo.breach_fingerprint([mk(11.3, 22.7, 6.9)]))
+    other = {"objective": "rss_slope", "node": "val0",
+             "window": [10.0, 20.0], "observed": 5.1,
+             "attribution": {"plane": "unattributed", "stage": "unknown"}}
+    assert (slo.breach_fingerprint([mk(10.0, 20.0, 5.1)])
+            != slo.breach_fingerprint([other]))
+
+
+def test_schedule_fingerprint_is_content_addressed():
+    ev = [{"t0": 1.0, "t1": 2.0, "plane": "corrupt"}]
+    assert (slo.schedule_fingerprint(ev)
+            == slo.schedule_fingerprint([dict(ev[0])]))
+    assert (slo.schedule_fingerprint(ev)
+            != slo.schedule_fingerprint(
+                [{"t0": 1.0, "t1": 3.0, "plane": "corrupt"}]))

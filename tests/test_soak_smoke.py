@@ -1,0 +1,61 @@
+"""Tier-1 soak smoke: a real (small) game day through tools/soak.py — a
+2-node fleet under continuous signed load with the corruption window armed
+— plus the slow-marked 2-seed determinism diff the --verify-determinism
+flag runs. The full 8-node multi-plane game day lives in tools/soak.py
+--ci and the chaos matrix's soak.gameday cell; tier-1 proves the plane
+end-to-end without the wall-clock bill."""
+
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.libs.toolbox import load_tool
+
+
+def test_soak_smoke_two_nodes(tmp_path, monkeypatch):
+    # pin what run_soak would setdefault/export so pytest-process env
+    # state is restored after the test
+    monkeypatch.setenv("TMTPU_BATCH_BACKEND", "host")
+    monkeypatch.setenv("TMTPU_SOAK_REPORT", "")
+    soak = load_tool("soak")
+
+    out = str(tmp_path / "soak_report.json")
+    plan = soak.plan_gameday(1, n_nodes=2, duration_s=20.0)
+    assert [ev["plane"] for ev in plan["events"]] == ["corrupt"]
+
+    rep = soak.run_soak(n_nodes=2, seed=1, duration_s=20.0, out=out)
+
+    # the fleet made progress under load + corruption
+    assert rep["heights"]["final"] > rep["heights"]["initial"], rep["heights"]
+    assert rep["load"]["sent"] > 0
+    assert rep["slo"]["sample_counts"].get("commit_latency", 0) > 0
+    # the live run executed exactly the pure plan
+    assert rep["schedule_fingerprint"] == soak.schedule_fingerprint(plan)
+    assert sorted(p for p, _ in rep["executed"]) == ["corrupt"]
+    assert not rep["event_errors"], rep["event_errors"]
+    # every breach leaves with an attribution — a named plane or the loud
+    # "unattributed", never silence
+    for b in rep["slo"]["breaches"]:
+        att = b.get("attribution")
+        assert att and att.get("plane"), f"silent breach: {b}"
+    assert rep["slo"]["unattributed"] == sum(
+        1 for b in rep["slo"]["breaches"]
+        if b["attribution"]["plane"] == "unattributed")
+    # the report landed on disk and round-trips
+    assert os.path.exists(out)
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["breach_fingerprint"] == rep["breach_fingerprint"]
+    # per-node process series made it into the fleet rollup
+    proc = rep["fleet_rollup"]["process"]
+    assert set(proc) == {"val0", "val1"}, proc
+
+
+@pytest.mark.slow
+def test_verify_determinism_across_seeds():
+    soak = load_tool("soak")
+    res = soak.verify_determinism(seeds=(1, 2))
+    assert res["ok"], res
+    fps = {s["schedule_fingerprint"] for s in res["seeds"].values()}
+    assert len(fps) == 2, "different seeds must draw different schedules"

@@ -46,8 +46,10 @@ DEFAULT_THRESHOLD = 0.30
 #: units gated as higher-is-better throughput
 HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s",
                        "commits/s"}
-#: units gated as lower-is-better latency
-LOWER_BETTER_UNITS = {"s", "ms"}
+#: units gated as lower-is-better latency; "breaches" is the soak
+#: plane's SLO-miss count (tools/soak.py) — more breaches is strictly
+#: worse, same gating shape as a latency
+LOWER_BETTER_UNITS = {"s", "ms", "breaches"}
 #: ratio-unit metrics gated lower-is-better DESPITE ratios defaulting to
 #: informational: the 10k flagship's packing share crept 7% -> 11.1%
 #: r04 -> r05 with nothing watching — cost-structure creep in these trips
@@ -525,6 +527,44 @@ def self_test() -> int:
             "--threshold",
             "verify_commit_1000val_bls_aggregated_commits_per_sec=0.9",
             ag_base, ag_bad]) == 0
+        # the soak rows: the "breaches" unit gates lower-better in BOTH
+        # directions — more SLO misses regress, fewer read improved —
+        # and missing/errored rows trip like any gated metric
+        assert gate_direction("inproc_soak_slo_breaches",
+                              "breaches") == "down"
+        so_base = os.path.join(d, "soak_base.json")
+        _write(so_base, {"inproc_soak_slo_breaches": (2.0, "breaches"),
+                         "inproc_soak_commit_p99_s": (6.0, "s")})
+        so_bad = os.path.join(d, "soak_bad.json")
+        _write(so_bad, {"inproc_soak_slo_breaches": (9.0, "breaches"),
+                        "inproc_soak_commit_p99_s": (6.0, "s")})
+        assert main([so_base, so_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(so_base), load_bench(so_bad), {})}
+        assert rows["inproc_soak_slo_breaches"]["status"] == "regressed"
+        so_good = os.path.join(d, "soak_good.json")
+        _write(so_good, {"inproc_soak_slo_breaches": (0.0, "breaches"),
+                         "inproc_soak_commit_p99_s": (5.5, "s")})
+        assert main([so_base, so_good]) == 0
+        rows = {r["metric"]: r for r in compare(
+            load_bench(so_base), load_bench(so_good), {})}
+        assert rows["inproc_soak_slo_breaches"]["status"] == "improved"
+        so_gone = os.path.join(d, "soak_gone.json")
+        _write(so_gone, {"inproc_soak_commit_p99_s": (6.0, "s")})
+        rows = {r["metric"]: r for r in compare(
+            load_bench(so_base), load_bench(so_gone), {})}
+        assert rows["inproc_soak_slo_breaches"]["status"] == "missing"
+        assert main([so_base, so_gone]) == 1
+        so_err = os.path.join(d, "soak_err.json")
+        _write(so_err, {"inproc_soak_slo_breaches": (0.0, "error"),
+                        "inproc_soak_commit_p99_s": (6.0, "s")})
+        rows = {r["metric"]: r for r in compare(
+            load_bench(so_base), load_bench(so_err), {})}
+        assert rows["inproc_soak_slo_breaches"]["status"] == "errored"
+        assert main([so_base, so_err]) == 1
+        # ...and a loosened per-metric threshold un-trips the soak gate
+        assert main(["--threshold", "inproc_soak_slo_breaches=4",
+                     so_base, so_bad]) == 0
         # the driver's record format ({"tail": jsonl}) parses identically
         drv = os.path.join(d, "driver.json")
         with open(drv, "w") as f:

@@ -141,6 +141,8 @@ SITES = {
     "crash.torn_wal": False,
     "crash.privval": False,
     "crash.loop": False,
+    # game-day cell (the SLO soak plane; tools/soak.py + libs/slo.py)
+    "soak.gameday": False,
 }
 
 
@@ -1217,6 +1219,46 @@ def cell_aggsig_degrade(seed: int) -> None:
     assert vec.stats["device_calls"] >= 1, vec.stats
 
 
+def _soak_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import soak
+
+    return soak
+
+
+def cell_soak_gameday(seed: int) -> None:
+    """A compressed game day through the SLO soak plane: the chaos
+    schedule must be a pure function of the seed, the live fleet must
+    make height progress under the armed corrupt+churn windows, and
+    every SLO breach the engine raises must leave with an attribution —
+    a named plane or the loud ``unattributed``, never silence."""
+    import tempfile
+
+    soak = _soak_mod()
+
+    plan_a = soak.plan_gameday(seed, n_nodes=5, duration_s=22.0)
+    plan_b = soak.plan_gameday(seed, n_nodes=5, duration_s=22.0)
+    assert plan_a == plan_b, "gameday plan is not seed-deterministic"
+    assert soak.schedule_fingerprint(plan_a) == \
+        soak.schedule_fingerprint(plan_b)
+    planes = [ev["plane"] for ev in plan_a["events"]]
+    assert planes == ["churn", "corrupt"], planes  # 5 nodes: one spare full
+
+    out = os.path.join(tempfile.mkdtemp(prefix="chaos_soak_"),
+                       "soak_report.json")
+    rep = soak.run_soak(n_nodes=5, seed=seed, duration_s=22.0, out=out)
+    assert rep["schedule_fingerprint"] == soak.schedule_fingerprint(plan_a), \
+        "live run drifted from the pure plan"
+    assert rep["heights"]["final"] > rep["heights"]["initial"], rep["heights"]
+    assert sorted(p for p, _ in rep["executed"]) == sorted(planes), \
+        rep["executed"]
+    assert not rep["event_errors"], rep["event_errors"]
+    for b in rep["slo"]["breaches"]:
+        att = b.get("attribution")
+        assert att and att.get("plane"), f"silent breach: {b}"
+    assert os.path.exists(out), "report never written"
+
+
 CELLS = {
     "device.batch_verify": cell_device_batch_verify,
     "device.lane": cell_device_lane,
@@ -1240,6 +1282,7 @@ CELLS = {
     "crash.torn_wal": cell_crash_torn_wal,
     "crash.privval": cell_crash_privval,
     "crash.loop": cell_crash_loop,
+    "soak.gameday": cell_soak_gameday,
 }
 assert set(CELLS) == set(SITES)
 

@@ -65,6 +65,16 @@ def scrape_endpoint(url: str, timeout: float = 2.0) -> Dict[str, float]:
         return parse_metrics(r.read().decode())
 
 
+def _value_by_suffix(sample: Dict[str, float], suffix: str) -> Optional[float]:
+    """First series whose bare name ends with ``suffix`` (label-free
+    gauges; suffix-matched so per-node registry namespaces don't hide
+    them)."""
+    for s, v in sample.items():
+        if s.split("{", 1)[0].endswith(suffix):
+            return v
+    return None
+
+
 def _median(vals: List[float]) -> float:
     s = sorted(vals)
     n = len(s)
@@ -75,12 +85,15 @@ def _median(vals: List[float]) -> float:
 class FleetScraper:
     """Poll N /metrics endpoints on an interval; rollup() aggregates."""
 
-    def __init__(self, endpoints: Dict[str, str], interval_s: float = 2.0,
+    def __init__(self, endpoints: Dict[str, object], interval_s: float = 2.0,
                  namespace: str = DEFAULT_NAMESPACE,
                  out_path: Optional[str] = None):
-        """``endpoints`` maps node name -> /metrics URL. ``out_path``, if
-        set, gets a fresh rollup JSON after every sweep (the debugdump
-        seam: TMTPU_FLEET_JSON points nodes at this file)."""
+        """``endpoints`` maps node name -> /metrics URL, or to a CALLABLE
+        returning exposition text (in-proc fleets — tools/soak.py passes
+        each node's ``registry.render`` so the whole pipeline runs with
+        no HTTP servers). ``out_path``, if set, gets a fresh rollup JSON
+        after every sweep (the debugdump seam: TMTPU_FLEET_JSON points
+        nodes at this file)."""
         self.endpoints = dict(endpoints)
         self.interval_s = interval_s
         self.namespace = namespace
@@ -111,8 +124,10 @@ class FleetScraper:
         stall scenario the debugdump snapshot targets) would stretch one
         sweep past interval_s and stale the rollup."""
 
-        def one(name: str, url: str):
+        def one(name: str, url):
             try:
+                if callable(url):
+                    return name, parse_metrics(url()), time.time()
                 return name, scrape_endpoint(url), time.time()
             except Exception:
                 return name, None, 0.0
@@ -243,7 +258,39 @@ class FleetScraper:
                     admitted / window, 3)
                 out["cluster_rpc_requests_per_sec"] = round(
                     rpc_reqs / window, 3)
+        # per-node process watermarks (libs/watermark.py sampler): last
+        # value + growth slope over the scrape window, clamped at zero
+        # (a restarted node resets its gauges — same rate()-style
+        # counter-reset handling as counter_delta). Matched by series
+        # SUFFIX, not full name: in-proc fleets give every node its own
+        # registry namespace, and the leak-slope SLO must still see them.
+        process: Dict[str, dict] = {}
+        for n in nodes:
+            t0, s0 = first[n]
+            t1, s1 = last[n]
+            window = t1 - t0
+            rec = {}
+            for suffix in self.PROCESS_SUFFIXES:
+                v1 = _value_by_suffix(s1, suffix)
+                if v1 is None:
+                    continue
+                v0 = _value_by_suffix(s0, suffix)
+                grown = max(0.0, v1 - (v1 if v0 is None else v0))
+                rec[suffix[len("process_"):]] = {
+                    "last": v1,
+                    "slope_per_s": (round(grown / window, 3)
+                                    if window > 0 else 0.0),
+                }
+            if rec:
+                process[n] = rec
+        if process:
+            out["process"] = process
         return out
+
+    #: the watermark gauge family (ProcessMetrics), namespace-agnostic
+    PROCESS_SUFFIXES = ("process_rss_bytes", "process_open_fds",
+                        "process_wal_bytes", "process_txlife_ring_depth",
+                        "process_metric_series")
 
     def write(self, path: str) -> str:
         import os
@@ -300,6 +347,16 @@ def _serve_synthetic(n_nodes: int):
                     '{stage="commit_finalized"} 10',
                     'tendermint_consensus_stage_seconds_bucket'
                     '{le="+Inf",stage="commit_finalized"} 10',
+                    # process watermarks: rss ramps (leak-slope subject),
+                    # wal SHRINKS (clamped to 0 slope — gauge reset
+                    # handling), the rest hold steady
+                    "tendermint_process_rss_bytes "
+                    + str(1_000_000 + 4096 * state["hits"]),
+                    "tendermint_process_open_fds 32",
+                    "tendermint_process_wal_bytes "
+                    + str(max(0, 16384 - 1000 * state["hits"])),
+                    "tendermint_process_txlife_ring_depth 7",
+                    "tendermint_process_metric_series 450",
                 ]).encode() + b"\n"
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
@@ -355,6 +412,16 @@ def self_test() -> int:
         assert roll["rpc_requests_delta"] == 24.0, roll
         assert roll["cluster_txs_admitted_per_sec"] > 0, roll
         assert roll["cluster_rpc_requests_per_sec"] > 0, roll
+        # process watermarks: rss grew 4096 over the window (positive
+        # slope), wal SHRANK (slope clamps to 0.0, not negative), and
+        # steady gauges report zero slope with a live last value
+        proc = roll["process"]["node0"]
+        assert proc["rss_bytes"]["last"] == 1_000_000 + 8192, proc
+        assert proc["rss_bytes"]["slope_per_s"] > 0, proc
+        assert proc["wal_bytes"]["slope_per_s"] == 0.0, proc
+        assert proc["open_fds"] == {"last": 32.0, "slope_per_s": 0.0}, proc
+        assert proc["txlife_ring_depth"]["last"] == 7.0, proc
+        assert proc["metric_series"]["last"] == 450.0, proc
         # threaded mode + out_path freshness
         import os
         import tempfile
@@ -377,6 +444,29 @@ def self_test() -> int:
                            interval_s=0.05)
         assert sc3.sweep() == 0 and sc3.errors == 1
         assert sc3.rollup()["n_nodes"] == 0
+        # callable endpoints (in-proc fleets, no HTTP): scraped through
+        # the same parse path, and the process rollup still finds the
+        # watermarks under a per-node registry namespace
+        calls = {"n": 0}
+
+        def render():
+            calls["n"] += 1
+            return (f"churn_val0_12345_process_rss_bytes "
+                    f"{100.0 + calls['n']}\n"
+                    f"churn_val0_12345_consensus_committed_height 5\n")
+
+        sc4 = FleetScraper({"inproc": render}, interval_s=0.05)
+        assert sc4.sweep() == 1
+        time.sleep(0.05)
+        assert sc4.sweep() == 1
+        r4 = sc4.rollup()
+        assert r4["process"]["inproc"]["rss_bytes"]["last"] == 102.0, r4
+        assert r4["process"]["inproc"]["rss_bytes"]["slope_per_s"] > 0, r4
+        # a raising callable counts as a scrape error, not a crash
+        def boom():
+            raise RuntimeError("down")
+        sc5 = FleetScraper({"bad": boom}, interval_s=0.05)
+        assert sc5.sweep() == 0 and sc5.errors == 1
     finally:
         for s in servers:
             s.shutdown()
