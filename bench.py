@@ -1544,6 +1544,224 @@ def bench_soak():
             _emit(m, 0.0, "error", 0.0, error=f"{type(e).__name__}: {e}")
 
 
+def _mk_light_serve_chain(n_vals: int, n_heights: int, chain_id: str,
+                          scheme: str = "ed25519"):
+    """Signed LightBlock chain for the serving-plane A/B: real headers
+    (hash-linked, valset hashes bound) with fully-signed commits — the
+    CommitSig list per height for ed25519, ONE aggregate per height on a
+    registered BLS chain."""
+    import hashlib
+
+    from tendermint_tpu import crypto
+    from tendermint_tpu.types import Validator, ValidatorSet
+    from tendermint_tpu.types.basic import (
+        BlockID,
+        BlockIDFlag,
+        PartSetHeader,
+        SignedMsgType,
+    )
+    from tendermint_tpu.types.block import Commit, CommitSig, Consensus, Header
+    from tendermint_tpu.types.canonical import vote_sign_bytes
+    from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+
+    t0_ns = 1_700_000_000_000_000_000
+    if scheme == "bls12381":
+        from tendermint_tpu.crypto import bls12381 as bls
+        from tendermint_tpu.crypto import schemes
+        from tendermint_tpu.libs.bits import BitArray
+        from tendermint_tpu.types.block import AggregatedCommit
+        from tendermint_tpu.types.params import SignatureParams
+
+        schemes.register_chain(chain_id, SignatureParams("bls12381", True))
+        privs = [crypto.Bls12381PrivKey.generate(
+            hashlib.sha256(f"lsrv-{chain_id}-{i}".encode()).digest())
+            for i in range(n_vals)]
+    else:
+        privs = [crypto.Ed25519PrivKey.generate(
+            hashlib.sha256(f"lsrv-{chain_id}-{i}".encode()).digest())
+            for i in range(n_vals)]
+    vs = ValidatorSet([Validator(p.pub_key().address(), p.pub_key(), 10)
+                       for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    blocks = {}
+    last_bid = BlockID(b"", PartSetHeader())
+    for h in range(1, n_heights + 1):
+        header = Header(
+            version=Consensus(), chain_id=chain_id, height=h,
+            time_ns=t0_ns + h * 1_000_000_000, last_block_id=last_bid,
+            last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+            validators_hash=vs.hash(), next_validators_hash=vs.hash(),
+            consensus_hash=b"\x03" * 32, app_hash=b"\x04" * 32,
+            last_results_hash=b"\x05" * 32, evidence_hash=b"\x06" * 32,
+            proposer_address=vs.validators[0].address)
+        bid = BlockID(header.hash(), PartSetHeader(1, b"\x07" * 32))
+        if scheme == "bls12381":
+            from tendermint_tpu.crypto import schemes
+
+            msg = vote_sign_bytes(chain_id, SignedMsgType.PRECOMMIT, h, 0,
+                                  bid, schemes.AGG_ZERO_TS_NS)
+            agg = bls.aggregate([by_addr[v.address].sign(msg)
+                                 for v in vs.validators])
+            signers = BitArray(n_vals)
+            for i in range(n_vals):
+                signers.set_index(i, True)
+            commit = AggregatedCommit(h, 0, bid, [], signers=signers,
+                                      agg_sig=agg,
+                                      timestamp_ns=header.time_ns)
+        else:
+            sigs = []
+            for i, v in enumerate(vs.validators):
+                ts = header.time_ns + i
+                msg = vote_sign_bytes(chain_id, SignedMsgType.PRECOMMIT,
+                                      h, 0, bid, ts)
+                sigs.append(CommitSig(BlockIDFlag.COMMIT, v.address, ts,
+                                      by_addr[v.address].sign(msg)))
+            commit = Commit(h, 0, bid, sigs)
+        blocks[h] = LightBlock(SignedHeader(header, commit), vs)
+        last_bid = bid
+    return blocks
+
+
+def _lightserve_requests(blocks, spans, per_span: int, now_ns: int):
+    """The fleet's ask: ``per_span`` clients per (trusted, target) span —
+    the steady-state where thousands of clients bisect the same heights."""
+    from tendermint_tpu.light.serve import VerifyRequest
+
+    reqs = []
+    for i in range(per_span):
+        for t, h in spans:
+            reqs.append(VerifyRequest(
+                blocks[t].signed_header, blocks[t].validator_set,
+                blocks[h].signed_header, blocks[h].validator_set,
+                3600.0, now_ns, 10.0, (1, 3), cache_key=(t, h)))
+    return reqs
+
+
+def _lightserve_run_coalesced(reqs, flush_max: int = 64,
+                              deadline_s: float = 0.002):
+    """One fleet burst through a FRESH coalescer; returns (wall, per-client
+    sojourn latencies, coalescer stats)."""
+    import asyncio
+
+    from tendermint_tpu.light.serve import VerifyCoalescer
+
+    lat = []
+
+    async def run():
+        co = VerifyCoalescer(flush_deadline_s=deadline_s,
+                             flush_max=flush_max)
+        try:
+            async def one(r):
+                t0 = time.perf_counter()
+                res = await co.submit(r)
+                lat.append(time.perf_counter() - t0)
+                return res
+
+            t0 = time.perf_counter()
+            results = await asyncio.gather(*[one(r) for r in reqs])
+            wall = time.perf_counter() - t0
+            bad = [r for r in results if r is not None]
+            assert not bad, f"coalesced serving rejected honest spans: {bad[:2]}"
+            return wall, dict(co.stats)
+        finally:
+            co.stop()
+
+    wall, stats = asyncio.run(run())
+    return wall, lat, stats
+
+
+def _lightserve_run_scalar(reqs):
+    """The pre-coalescer serving plane: one scalar verifier.verify per
+    request, FIFO. Latencies are sojourn times for a burst arriving at t0 —
+    what a concurrent client actually waits on a one-at-a-time server."""
+    from tendermint_tpu.light import verifier
+
+    lat = []
+    t0 = time.perf_counter()
+    for r in reqs:
+        verifier.verify(r.trusted_sh, r.trusted_vals, r.untrusted_sh,
+                        r.untrusted_vals, r.trusting_period_s, r.now_ns,
+                        r.max_clock_drift_s, r.trust_level)
+        lat.append(time.perf_counter() - t0)
+    return time.perf_counter() - t0, lat
+
+
+def _p99(lat):
+    s = sorted(lat)
+    return s[min(len(s) - 1, int(0.99 * (len(s) - 1) + 0.999))]
+
+
+def bench_lightserve():
+    """Config lightserve: the light-client serving plane A/B. A 96-client
+    fleet trusting-verifies a handful of spans over a 16-validator chain:
+    scalar = one verifier.verify per request FIFO (the pre-plane serving
+    path); coalesced = the same requests through VerifyCoalescer (ONE
+    batched precompute + scalar-spec replay, dedup + verdict cache).
+    Gated rows: fleet headers/s (higher-better; vs_baseline is the A/B
+    ratio over scalar) and p99 client sojourn (lower-better). The BLS
+    aggregated plane rides along at 8 validators — a flush there is a
+    handful of pairings."""
+    from tendermint_tpu.crypto import schemes
+
+    t0_ns = 1_700_000_000_000_000_000
+    now_ns = t0_ns + 100 * 1_000_000_000
+    try:
+        blocks = _mk_light_serve_chain(16, 12, "lightserve-bench-ed")
+        spans = [(1, 12), (2, 12), (1, 8), (3, 10), (2, 9), (4, 11)]
+        reqs = _lightserve_requests(blocks, spans, 16, now_ns)  # 96 clients
+
+        _lightserve_run_scalar(reqs)  # warm (sign-bytes memos, jit)
+        _lightserve_run_coalesced(reqs)
+        sc_wall = sc_lat = None
+        for _ in range(3):
+            wall, lat = _lightserve_run_scalar(reqs)
+            if sc_wall is None or wall < sc_wall:
+                sc_wall, sc_lat = wall, lat
+        co_wall = co_lat = stats = None
+        for _ in range(3):
+            wall, lat, st = _lightserve_run_coalesced(reqs)
+            if co_wall is None or wall < co_wall:
+                co_wall, co_lat, stats = wall, lat, st
+        scalar_rate = len(reqs) / sc_wall
+        co_rate = len(reqs) / co_wall
+        _emit("lightserve_clients_headers_per_sec", co_rate, "headers/s",
+              co_rate / scalar_rate, clients=len(reqs),
+              spans=len(spans), scalar_headers_per_sec=round(scalar_rate, 1),
+              flushes=stats["flushes"], largest_flush=stats["largest_flush"],
+              verified_requests=stats["verified_requests"],
+              coalesced_dupes=stats["coalesced_dupes"],
+              verdict_cache_hits=stats["verdict_cache_hits"],
+              batched_sigs=stats["batched_sigs"])
+        _emit("lightserve_p99_s", _p99(co_lat), "s",
+              _p99(co_lat) / _p99(sc_lat), clients=len(reqs),
+              scalar_p99_s=round(_p99(sc_lat), 6),
+              scalar_p50_s=round(sorted(sc_lat)[len(sc_lat) // 2], 6),
+              coalesced_p50_s=round(sorted(co_lat)[len(co_lat) // 2], 6))
+
+        # the BLS aggregated plane: same fleet discipline, pairing regime
+        bls_blocks = _mk_light_serve_chain(8, 8, "lightserve-bench-bls",
+                                           scheme="bls12381")
+        bls_spans = [(1, 8), (2, 8), (1, 5), (3, 7)]
+        bls_reqs = _lightserve_requests(bls_blocks, bls_spans, 8, now_ns)
+        _lightserve_run_scalar(bls_reqs)
+        _lightserve_run_coalesced(bls_reqs)
+        bls_sc_wall, _ = _lightserve_run_scalar(bls_reqs)
+        bls_co_wall, _, bls_stats = _lightserve_run_coalesced(bls_reqs)
+        bls_sc_rate = len(bls_reqs) / bls_sc_wall
+        bls_co_rate = len(bls_reqs) / bls_co_wall
+        _emit("lightserve_bls_clients_headers_per_sec", bls_co_rate,
+              "headers/s", bls_co_rate / bls_sc_rate, clients=len(bls_reqs),
+              scalar_headers_per_sec=round(bls_sc_rate, 1),
+              verified_requests=bls_stats["verified_requests"],
+              batched_sigs=bls_stats["batched_sigs"])
+    except Exception as e:
+        for m in ("lightserve_clients_headers_per_sec", "lightserve_p99_s",
+                  "lightserve_bls_clients_headers_per_sec"):
+            _emit(m, 0.0, "error", 0.0, error=f"{type(e).__name__}: {e}")
+    finally:
+        schemes.reset()
+
+
 CONFIGS = {
     "1": bench_stream,
     "2": bench_verify_commit_150,
@@ -1556,6 +1774,7 @@ CONFIGS = {
     "crash": bench_crash,
     "exec": bench_exec,
     "aggsig": bench_aggsig,
+    "lightserve": bench_lightserve,
     "soak": bench_soak,
     "10k": bench_verify_commit_10k,
 }
@@ -1603,7 +1822,8 @@ if __name__ == "__main__":
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
             for key in ("2", "3", "4", "ingest", "churn", "crash", "exec",
-                        "aggsig", "soak", "5", "1", "multichip", "10k"):
+                        "aggsig", "lightserve", "soak", "5", "1", "multichip",
+                        "10k"):
                 for attempt in (1, 2):
                     try:
                         with _tracer.span(f"config_{key}"):

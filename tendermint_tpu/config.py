@@ -64,6 +64,10 @@ class RPCConfig:
     max_body_bytes: int = 1000000
     max_header_bytes: int = 1 << 20
     pprof_laddr: str = ""
+    # per-socket bounded websocket send queue: a subscriber that stops
+    # reading is EVICTED when its queue overflows (rpc/server._WsFanout)
+    # instead of backing up the event bus
+    ws_send_queue_size: int = 256
 
 
 @dataclass
@@ -169,6 +173,28 @@ class ExecutionConfig:
 
 
 @dataclass
+class LightServeConfig:
+    """Light-client serving plane (light/serve.py). No reference analog —
+    tendermint serves light clients one scalar RPC at a time; this build
+    coalesces a population of them into shared device batches."""
+
+    enable: bool = True
+    # coalescer: flush after this many ms from the first queued request,
+    # or as soon as flush_max requests accumulate, whichever first
+    flush_deadline_ms: float = 2.0
+    flush_max: int = 64
+    queue_limit: int = 4096            # pending verifies; beyond: queue-full
+    cache_capacity: int = 1024         # header/commit docs resident
+    verdict_cache_size: int = 4096     # remembered verify verdicts
+    prefetch_limit: int = 16           # bisection-skeleton heights pinned
+    per_client_rate: float = 0.0       # requests/s per client id; 0 disables
+    per_client_burst: int = 16
+    abuse_ban_threshold: int = 8       # consecutive rate strikes before ban
+    trusting_period_s: float = 14 * 24 * 3600.0
+    max_clock_drift_s: float = 10.0
+
+
+@dataclass
 class StorageConfig:
     """(config/config.go:1081 StorageConfig)"""
 
@@ -198,7 +224,7 @@ class InstrumentationConfig:
 _SECTIONS = [
     ("rpc", RPCConfig), ("p2p", P2PConfig), ("mempool", MempoolConfig),
     ("statesync", StateSyncConfig), ("fastsync", FastSyncConfig),
-    ("execution", ExecutionConfig),
+    ("execution", ExecutionConfig), ("lightserve", LightServeConfig),
     ("consensus", ConsensusConfig), ("storage", StorageConfig),
     ("tx_index", TxIndexConfig), ("instrumentation", InstrumentationConfig),
 ]
@@ -216,6 +242,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     execution: ExecutionConfig = field(default_factory=ExecutionConfig)
+    lightserve: LightServeConfig = field(default_factory=LightServeConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
@@ -283,6 +310,16 @@ class Config:
             raise ValueError("execution.workers must be positive")
         if self.execution.min_parallel_txs < 0:
             raise ValueError("execution.min_parallel_txs cannot be negative")
+        if self.lightserve.flush_max <= 0:
+            raise ValueError("lightserve.flush_max must be positive")
+        if self.lightserve.flush_deadline_ms < 0:
+            raise ValueError("lightserve.flush_deadline_ms cannot be negative")
+        if self.lightserve.cache_capacity <= 0:
+            raise ValueError("lightserve.cache_capacity must be positive")
+        if self.lightserve.queue_limit <= 0:
+            raise ValueError("lightserve.queue_limit must be positive")
+        if self.rpc.ws_send_queue_size <= 0:
+            raise ValueError("rpc.ws_send_queue_size must be positive")
         if self.tx_index.indexer not in ("kv", "null", "psql"):
             raise ValueError(f"unknown indexer {self.tx_index.indexer!r}")
 

@@ -112,6 +112,10 @@ KNOWN_SITES = frozenset({
     "statesync.lying_snapshot",
     "statesync.lying_chunk",
     "blocksync.bad_block",
+    # lying light-block server (light/serve.py): a fired site swaps the
+    # served header for a tampered/forged one — witness cross-check must
+    # catch it and strike the liar on the peerscore ledger
+    "lightserve.lying_server",
     # torn-write (crash) sites — consulted via tear()/tear_index()
     "wal.torn_write",
     "db.torn_write",

@@ -490,6 +490,53 @@ class RPCMetrics:
         self.response_size_bytes = h(
             "rpc", "response_size_bytes",
             "Serialized JSON response bytes.", buckets=self.SIZE_BUCKETS)
+        self.ws_slow_consumer_evictions_total = c(
+            "rpc", "ws_slow_consumer_evictions_total",
+            "Websocket subscribers evicted because their bounded send "
+            "queue overflowed (a stalled reader must never back up the "
+            "event bus).")
+
+
+class LightServeMetrics:
+    """The light-client serving plane (light/serve.py): coalescer flush
+    shape, header-cache effectiveness, and reason-labeled admission sheds
+    for a population of thousands of concurrent light clients."""
+
+    OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+    def __init__(self, reg: Registry):
+        c, h = reg.counter, reg.histogram
+        self.requests_total = c(
+            "lightserve", "requests_total",
+            "Serving requests per route.", ["route"])
+        self.sheds_total = c(
+            "lightserve", "sheds_total",
+            "Admission sheds per reason (client-rate, banned, queue-full); "
+            "every shed is an explicit RPC error, never a stall.",
+            ["reason"])
+        self.flushes_total = c(
+            "lightserve", "flushes_total",
+            "Coalescer flushes (one batched device call each).")
+        self.flush_occupancy = h(
+            "lightserve", "flush_occupancy",
+            "Verify requests per coalescer flush.",
+            buckets=self.OCCUPANCY_BUCKETS)
+        self.verdict_cache_hits_total = c(
+            "lightserve", "verdict_cache_hits_total",
+            "Verify requests answered from the bounded verdict cache.")
+        self.cache_hits_total = c(
+            "lightserve", "cache_hits_total",
+            "Header-cache hits on /light_header.")
+        self.cache_misses_total = c(
+            "lightserve", "cache_misses_total",
+            "Header-cache misses on /light_header.")
+        self.cache_prefetches_total = c(
+            "lightserve", "cache_prefetches_total",
+            "Bisection-skeleton heights prefetched and pinned.")
+        self.client_bans_total = c(
+            "lightserve", "client_bans_total",
+            "Clients banned by the abuse scoreboard, per reason.",
+            ["reason"])
 
 
 class StateMetrics:
@@ -793,6 +840,7 @@ class NodeMetrics:
         self.consensus = ConsensusMetrics(self.registry)
         self.mempool = MempoolMetrics(self.registry)
         self.rpc = RPCMetrics(self.registry)
+        self.lightserve = LightServeMetrics(self.registry)
         self.p2p = P2PMetrics(self.registry)
         self.state = StateMetrics(self.registry)
         self.crypto = CryptoMetrics(self.registry)

@@ -452,6 +452,16 @@ class Node:
         for name, r in reactors.items():
             self.switch.add_reactor(name, r)
 
+        # -- light-client serving plane (light/serve.py) ----------------------
+        self.light_serve = None
+        if config.lightserve.enable:
+            from .light.serve import LightServePlane
+
+            self.light_serve = LightServePlane(
+                block_store=self.block_store, state_store=self.state_store,
+                chain_id=genesis.chain_id, config=config.lightserve,
+                metrics=self.metrics.lightserve)
+
         # -- RPC --------------------------------------------------------------
         self.rpc_server = None
         if config.rpc.laddr:
@@ -661,6 +671,9 @@ class Node:
         await self.switch.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.light_serve is not None:
+            # fail queued verifies with an explicit shed, cancel the timer
+            self.light_serve.stop()
         if self.ingest is not None:
             # settle any in-flight micro-batch so no submit future strands
             await self.ingest.stop()

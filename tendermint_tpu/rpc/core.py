@@ -546,6 +546,62 @@ class Environment:
         return {"txs": [_enc_tx_search_result(r) for r in sel],
                 "total_count": str(len(results))}
 
+    # -- light-client serving plane (light/serve.py) -------------------------
+
+    def _light_serve(self):
+        plane = getattr(self.node, "light_serve", None)
+        if plane is None:
+            raise RPCError(-32601, "light serving is disabled")
+        return plane
+
+    async def light_header(self, height: int = 0, trusted_height: int = 0,
+                           client: str = "") -> Dict[str, Any]:
+        """Signed header + commit for a light client, served through the
+        bisection-aware cache. A declared ``trusted_height`` prefetches and
+        pins the bisection-skeleton heights of the span."""
+        from ..light.serve import ShedError
+
+        plane = self._light_serve()
+        if height:
+            height = self._height_or_latest(height)
+        try:
+            return plane.serve_header(int(height), int(trusted_height),
+                                      client_id=str(client))
+        except ShedError as e:
+            raise RPCError(-32005, str(e), data=e.reason)
+        except KeyError as e:
+            raise RPCError(-32603, str(e))
+
+    async def light_verify(self, height: int, trusted_height: int,
+                           trust_num: int = 1, trust_den: int = 3,
+                           client: str = "") -> Dict[str, Any]:
+        """Trusting-verify ``height`` against ``trusted_height`` with the
+        node's own stores as the source, through the verification
+        coalescer: concurrent calls share ONE batched device dispatch and
+        get the scalar-spec verdict byte-identically."""
+        from ..light.serve import ShedError
+
+        plane = self._light_serve()
+        try:
+            err = await plane.serve_verify(
+                int(height), int(trusted_height),
+                trust_level=(int(trust_num), int(trust_den)),
+                client_id=str(client))
+        except ShedError as e:
+            raise RPCError(-32005, str(e), data=e.reason)
+        except KeyError as e:
+            raise RPCError(-32603, str(e))
+        if err is not None:
+            raise RPCError(-32010, f"light verification failed: {err}",
+                           data=type(err).__name__)
+        return {"verified": True, "height": str(int(height)),
+                "trusted_height": str(int(trusted_height)),
+                "trust_level": f"{int(trust_num)}/{int(trust_den)}"}
+
+    async def lightserve_status(self) -> Dict[str, Any]:
+        """Coalescer/cache/limiter counters for the serving plane."""
+        return self._light_serve().status()
+
     async def block_search(self, query: str, page: int = 1, per_page: int = 30,
                            order_by: str = "asc") -> Dict[str, Any]:
         idx = self.node.block_indexer
@@ -597,6 +653,7 @@ ROUTES = [
     "unconfirmed_txs", "num_unconfirmed_txs", "broadcast_tx_async",
     "broadcast_tx_sync", "broadcast_tx_commit", "broadcast_evidence",
     "tx", "tx_search", "block_search",
+    "light_header", "light_verify", "lightserve_status",
 ]
 
 # served only when config.rpc.unsafe is set (routes.go:52 AddUnsafeRoutes)

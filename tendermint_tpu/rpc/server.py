@@ -8,13 +8,14 @@ aiohttp-based; one server per node, bound to config.rpc.laddr.
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import os
 import time
 from typing import Any, Dict, Optional
 
-from aiohttp import WSMsgType, web
+from aiohttp import WSCloseCode, WSMsgType, web
 
 from .core import Environment, ROUTES, UNSAFE_ROUTES, RPCError
 
@@ -47,6 +48,10 @@ class RPCServer:
         self.slow_ms = _slow_ms_knob()
         self._runner: Optional[web.AppRunner] = None
         self._subscriptions: Dict[str, list] = {}  # ws id -> [sub ids]
+        # one serialized payload per published event, shared across every
+        # matching subscriber (see _event_fragment)
+        self._ws_frag_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
         self._routes = list(ROUTES)
         if getattr(node.config.rpc, "unsafe", False):
             self._routes += UNSAFE_ROUTES
@@ -170,6 +175,9 @@ class RPCServer:
         await ws.prepare(request)
         ws_id = f"ws-{id(ws)}"
         pumps: list = []
+        fan = _WsFanout(
+            ws, getattr(self.node.config.rpc, "ws_send_queue_size", 256),
+            on_evict=self._count_ws_eviction)
         if self.metrics is not None:
             self.metrics.websocket_subscribers.inc()
         try:
@@ -186,36 +194,117 @@ class RPCServer:
                 if method == "subscribe":
                     query = params.get("query", "")
                     sub = self.node.event_bus.subscribe(ws_id, query)
-                    await ws.send_json(_rpc_response(id_, result={}))
+                    fan.enqueue(json.dumps(_rpc_response(id_, result={})))
                     pumps.append(asyncio.create_task(
-                        self._pump(ws, id_, query, sub)))
+                        self._pump(fan, id_, query, sub)))
                 elif method == "unsubscribe_all" or method == "unsubscribe":
                     _quiet_unsubscribe(self.node.event_bus, ws_id)
-                    await ws.send_json(_rpc_response(id_, result={}))
+                    fan.enqueue(json.dumps(_rpc_response(id_, result={})))
                 else:
-                    await ws.send_json(await self._dispatch(req))
+                    fan.enqueue(json.dumps(await self._dispatch(req)))
         finally:
             if self.metrics is not None:
                 self.metrics.websocket_subscribers.inc(-1)
             _quiet_unsubscribe(self.node.event_bus, ws_id)
             for p in pumps:
                 p.cancel()
+            fan.stop()
         return ws
 
-    async def _pump(self, ws, id_, query: str, sub) -> None:
+    def _count_ws_eviction(self) -> None:
+        if self.metrics is not None:
+            self.metrics.ws_slow_consumer_evictions_total.inc()
+
+    def _event_fragment(self, msg) -> str:
+        """ONE serialized ``{"data": ..., "events": ...}`` payload per
+        published event, shared across every matching subscriber: pubsub
+        delivers the same Message object to each subscription, so the
+        fragment caches on its identity (the strong ref in the cache keeps
+        the id stable); _render_ws_frame wraps it per-subscription."""
+        key = id(msg)
+        hit = self._ws_frag_cache.get(key)
+        if hit is not None and hit[0] is msg:
+            return hit[1]
+        frag = json.dumps({"data": _encode_event_data(msg.data),
+                           "events": msg.events})
+        self._ws_frag_cache[key] = (msg, frag)
+        while len(self._ws_frag_cache) > 64:
+            self._ws_frag_cache.popitem(last=False)
+        return frag
+
+    async def _pump(self, fan: "_WsFanout", id_, query: str, sub) -> None:
         from ..libs.pubsub import SubscriptionCanceled
-        from ..types.event_bus import EventDataNewBlock, EventDataTx
 
         try:
             while True:
                 msg = await sub.next()
-                data = _encode_event_data(msg.data)
-                await ws.send_json(_rpc_response(id_, result={
-                    "query": query, "data": data,
-                    "events": msg.events,
-                }))
+                fan.enqueue(_render_ws_frame(id_, query,
+                                             self._event_fragment(msg)))
+                if fan.evicted:
+                    return
         except (SubscriptionCanceled, ConnectionError, asyncio.CancelledError):
             pass
+
+
+class _WsFanout:
+    """Per-socket bounded send queue with one sender task.
+
+    The old pump awaited each ``ws.send_json`` inline with no bound: one
+    stalled reader back-pressured the event bus for everyone. Now frames
+    are enqueued; a full queue EVICTS the socket — explicit close
+    (TRY_AGAIN_LATER) counted on rpc_ws_slow_consumer_evictions_total —
+    instead of stalling. The ws argument is duck-typed (send_str/close)
+    so the regression test can inject a never-reading socket."""
+
+    def __init__(self, ws, maxsize: int, on_evict=None):
+        self.ws = ws
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, int(maxsize)))
+        self.evicted = False
+        self._on_evict = on_evict
+        self._sender = asyncio.get_running_loop().create_task(self._drain())
+
+    async def _drain(self) -> None:
+        try:
+            while True:
+                text = await self.queue.get()
+                await self.ws.send_str(text)
+        except (ConnectionError, RuntimeError, asyncio.CancelledError):
+            pass
+
+    def enqueue(self, text: str) -> bool:
+        """Queue a frame; on overflow evict the socket. Returns False when
+        the frame was dropped (socket already evicted or overflowing)."""
+        if self.evicted:
+            return False
+        try:
+            self.queue.put_nowait(text)
+            return True
+        except asyncio.QueueFull:
+            self.evicted = True
+            if self._on_evict is not None:
+                self._on_evict()
+            self._sender.cancel()
+            asyncio.get_running_loop().create_task(self._close())
+            return False
+
+    async def _close(self) -> None:
+        try:
+            await self.ws.close(code=WSCloseCode.TRY_AGAIN_LATER,
+                                message=b"slow consumer")
+        except Exception:
+            pass
+
+    def stop(self) -> None:
+        self._sender.cancel()
+
+
+def _render_ws_frame(id_, query: str, fragment: str) -> str:
+    """Assemble a subscription frame around a shared pre-serialized
+    ``{"data": ..., "events": ...}`` fragment. MUST stay byte-identical to
+    ``json.dumps(_rpc_response(id_, result={"query": query, "data": ...,
+    "events": ...}))`` — pinned by the ws frame parity test."""
+    return ('{"jsonrpc": "2.0", "id": %s, "result": {"query": %s, %s}'
+            % (json.dumps(id_), json.dumps(query), fragment[1:]))
 
 
 def _quiet_unsubscribe(bus, subscriber: str) -> None:
@@ -243,7 +332,7 @@ def _encode_event_data(data) -> Dict[str, Any]:
 # URI params that are numeric; everything else stays a string (a hex "data"
 # param must not be swallowed by int())
 _NUMERIC_PARAMS = {"height", "page", "per_page", "limit", "min_height",
-                   "max_height"}
+                   "max_height", "trusted_height", "trust_num", "trust_den"}
 
 
 def _coerce(key: str, v: str):

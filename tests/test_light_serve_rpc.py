@@ -1,0 +1,136 @@
+"""Light-client serving plane over live RPC: an in-process node serving a
+64+ client fleet through /light_verify (coalesced into shared device
+batches) and /light_header (bisection-aware cache + prefetch), plus the
+per-client admission plane shedding an abuser with reason-labeled errors."""
+
+import asyncio
+
+import pytest
+
+pytest.importorskip("cryptography", reason="needs the optional 'cryptography' package (absent in slim containers)")
+
+from tests.test_node_rpc import _mk_node  # noqa: E402
+
+FLEET = 64
+
+
+async def _wait_height(client, h, tries=600):
+    for _ in range(tries):
+        st = await client.status()
+        if int(st["sync_info"]["latest_block_height"]) >= h:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"node never reached height {h}")
+
+
+def test_light_serve_fleet_end_to_end(tmp_path):
+    """>=64 concurrent clients verify the same span: every verdict comes
+    back accepted, the coalescer actually batched (flushes recorded, dupes
+    shared), and header serving hit the bisection-aware cache."""
+
+    async def run():
+        from tendermint_tpu.rpc.client import HTTPClient
+        from tendermint_tpu.rpc.core import RPCError
+
+        node = _mk_node(tmp_path)
+        await node.start()
+        try:
+            port = node.rpc_server.bound_port
+            client = HTTPClient(f"http://127.0.0.1:{port}")
+            await _wait_height(client, 9)
+
+            # the fleet: every client trusting-verifies height 8 against a
+            # small set of trusted heights, plus header fetches declaring
+            # the span (which prefetches the bisection skeleton)
+            async def one(i):
+                if i % 2:
+                    return await client.call(
+                        "light_verify", height=8,
+                        trusted_height=1 + (i % 3), client=f"c{i}")
+                return await client.call(
+                    "light_header", height=8, trusted_height=1,
+                    client=f"c{i}")
+
+            results = await asyncio.gather(*[one(i) for i in range(FLEET)])
+            for i, doc in enumerate(results):
+                if i % 2:
+                    assert doc["verified"] is True and doc["height"] == "8"
+                else:
+                    assert doc["signed_header"]["header"]["height"] == "8"
+                    assert doc["canonical"] is True
+
+            st = await client.call("lightserve_status")
+            co = st["coalescer"]
+            assert co["requests"] >= FLEET // 2
+            assert co["flushes"] >= 1
+            # the whole point: far fewer verifications than requests
+            assert co["verified_requests"] < co["requests"]
+            assert co["coalesced_dupes"] + co["verdict_cache_hits"] > 0
+            cache = st["cache"]
+            assert cache["hits"] > 0  # the fleet shared cached headers
+            assert st["served"]["prefetched"] > 0  # skeleton got pinned
+            assert cache["pinned"] > 0
+
+            # malformed span: explicit error, not a stall
+            with pytest.raises(RPCError) as ei:
+                await client.call("light_verify", height=2, trusted_height=8)
+            assert ei.value.code == -32603
+
+            # GET URI route serves the same doc
+            doc = await client.call("light_header", height=3)
+            assert doc["signed_header"]["header"]["height"] == "3"
+            await client.close()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_light_serve_rate_limit_sheds_abuser(tmp_path):
+    """A hammering client gets reason-labeled RPC errors (client-rate, then
+    banned via abuse scoring) while a polite client keeps being served."""
+
+    async def run():
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.rpc.client import HTTPClient
+        from tendermint_tpu.rpc.core import RPCError
+
+        orig = _mk_node(tmp_path)
+        cfg = orig.config
+        cfg.lightserve.per_client_rate = 0.001  # bucket never refills in-test
+        cfg.lightserve.per_client_burst = 2
+        cfg.lightserve.abuse_ban_threshold = 3
+        node = Node(cfg, orig.priv_validator, orig.node_key, orig.genesis)
+        await node.start()
+        try:
+            port = node.rpc_server.bound_port
+            client = HTTPClient(f"http://127.0.0.1:{port}")
+            await _wait_height(client, 3)
+
+            reasons = []
+            for _ in range(8):
+                try:
+                    await client.call("light_header", height=2,
+                                      client="abuser")
+                except RPCError as e:
+                    assert e.code == -32005
+                    reasons.append(e.data)
+            assert reasons.count("client-rate") >= 3
+            assert "banned" in reasons  # abuse scoring escalated
+            # the ban sticks even after the bucket would readmit
+            with pytest.raises(RPCError) as ei:
+                await client.call("light_header", height=2, client="abuser")
+            assert ei.value.data == "banned"
+
+            # a polite client is untouched by the abuser's ban
+            doc = await client.call("light_header", height=2, client="polite")
+            assert doc["signed_header"]["header"]["height"] == "2"
+
+            st = await client.call("lightserve_status")
+            assert st["limiter"]["rate_sheds"] >= 3
+            assert st["limiter"]["ban_sheds"] >= 1
+            await client.close()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

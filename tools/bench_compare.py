@@ -43,9 +43,11 @@ from typing import Dict, List, Optional
 
 DEFAULT_THRESHOLD = 0.30
 
-#: units gated as higher-is-better throughput
+#: units gated as higher-is-better throughput; "headers/s" is the
+#: light-client serving plane's fleet-throughput unit (bench.py config
+#: lightserve, tools/lightserve_bench.py)
 HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s",
-                       "commits/s"}
+                       "commits/s", "headers/s"}
 #: units gated as lower-is-better latency; "breaches" is the soak
 #: plane's SLO-miss count (tools/soak.py) — more breaches is strictly
 #: worse, same gating shape as a latency
@@ -89,6 +91,38 @@ def load_bench(path: str) -> Dict[str, dict]:
     if not out:
         raise ValueError(f"{path}: no bench metric lines found")
     return out
+
+
+def load_history(path: str):
+    """(labels, runs) from a cross-run history file: one JSON object per
+    line, ``{"label": ..., "metrics": [bench rows]}`` (tools/soak.py
+    --history appends these). A bare list of rows is accepted too, with
+    the line number as its label. Blank/comment lines are skipped."""
+    labels: List[str] = []
+    runs: List[Dict[str, dict]] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            doc = json.loads(line)
+            if isinstance(doc, list):
+                doc = {"label": f"run{i}", "metrics": doc}
+            if not isinstance(doc, dict) or "metrics" not in doc:
+                raise ValueError(
+                    f"{path}:{i}: want {{'label', 'metrics'}} per line")
+            run: Dict[str, dict] = {}
+            for rec in doc["metrics"]:
+                if isinstance(rec, dict) and "metric" in rec \
+                        and "value" in rec:
+                    run[rec["metric"]] = rec
+            if not run:
+                raise ValueError(f"{path}:{i}: no metric rows in entry")
+            labels.append(str(doc.get("label", f"run{i}")))
+            runs.append(run)
+    if not runs:
+        raise ValueError(f"{path}: empty history")
+    return labels, runs
 
 
 def gate_direction(metric: str, unit: str) -> Optional[str]:
@@ -565,6 +599,111 @@ def self_test() -> int:
         # ...and a loosened per-metric threshold un-trips the soak gate
         assert main(["--threshold", "inproc_soak_slo_breaches=4",
                      so_base, so_bad]) == 0
+        # the light-client serving rows gate BOTH directions: the fleet
+        # throughput ("headers/s") higher-better, the client p99 ("s")
+        # lower-better — a collapsed coalescer regresses on either axis,
+        # a faster one reads improved, and the crashed-config convention
+        # (unit "error") trips rather than un-gates
+        assert gate_direction("lightserve_clients_headers_per_sec",
+                              "headers/s") == "up"
+        assert gate_direction("lightserve_p99_s", "s") == "down"
+        ls_base = os.path.join(d, "lightserve_base.json")
+        _write(ls_base, {"lightserve_clients_headers_per_sec":
+                         (2000.0, "headers/s"),
+                         "lightserve_p99_s": (0.010, "s"),
+                         "lightserve_bls_clients_headers_per_sec":
+                         (400.0, "headers/s")})
+        ls_bad = os.path.join(d, "lightserve_bad.json")
+        _write(ls_bad, {"lightserve_clients_headers_per_sec":
+                        (800.0, "headers/s"),
+                        "lightserve_p99_s": (0.050, "s"),
+                        "lightserve_bls_clients_headers_per_sec":
+                        (400.0, "headers/s")})
+        assert main([ls_base, ls_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ls_base), load_bench(ls_bad), {})}
+        assert rows["lightserve_clients_headers_per_sec"][
+            "status"] == "regressed"
+        assert rows["lightserve_p99_s"]["status"] == "regressed"
+        ls_fast = os.path.join(d, "lightserve_fast.json")
+        _write(ls_fast, {"lightserve_clients_headers_per_sec":
+                         (3500.0, "headers/s"),
+                         "lightserve_p99_s": (0.004, "s"),
+                         "lightserve_bls_clients_headers_per_sec":
+                         (700.0, "headers/s")})
+        assert main([ls_base, ls_fast]) == 0
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ls_base), load_bench(ls_fast), {})}
+        assert rows["lightserve_clients_headers_per_sec"][
+            "status"] == "improved"
+        assert rows["lightserve_p99_s"]["status"] == "improved"
+        ls_gone = os.path.join(d, "lightserve_gone.json")
+        _write(ls_gone, {"lightserve_p99_s": (0.010, "s")})
+        assert main([ls_base, ls_gone]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ls_base), load_bench(ls_gone), {})}
+        assert rows["lightserve_clients_headers_per_sec"][
+            "status"] == "missing"
+        ls_err = os.path.join(d, "lightserve_err.json")
+        _write(ls_err, {"lightserve_clients_headers_per_sec":
+                        (0.0, "error"),
+                        "lightserve_p99_s": (0.010, "s"),
+                        "lightserve_bls_clients_headers_per_sec":
+                        (400.0, "headers/s")})
+        assert main([ls_base, ls_err]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(ls_base), load_bench(ls_err), {})}
+        assert rows["lightserve_clients_headers_per_sec"][
+            "status"] == "errored"
+        # ...and loosened per-metric thresholds un-trip the pair
+        assert main(["--threshold",
+                     "lightserve_clients_headers_per_sec=0.9",
+                     "--threshold", "lightserve_p99_s=9",
+                     ls_base, ls_bad]) == 0
+        # cross-run history (--history): the JSONL trend file soak.py
+        # appends to — the newest entry gates against the one before it,
+        # a drifting trend exits 1, an improving one exits 0, and a
+        # single entry has nothing to gate yet
+        hist_bad = os.path.join(d, "hist_bad.jsonl")
+        with open(hist_bad, "w") as f:
+            for label, breaches, p99 in (("r01", 0.0, 5.0),
+                                         ("r02", 1.0, 5.5),
+                                         ("r03", 6.0, 9.0)):
+                f.write(json.dumps({"label": label, "metrics": [
+                    {"metric": "inproc_soak_slo_breaches",
+                     "value": breaches, "unit": "breaches"},
+                    {"metric": "inproc_soak_commit_p99_s",
+                     "value": p99, "unit": "s"}]}) + "\n")
+        assert main(["--history", hist_bad]) == 1
+        labels, runs = load_history(hist_bad)
+        assert labels == ["r01", "r02", "r03"]
+        rows = {r["metric"]: r for r in compare(runs[-2], runs[-1], {})}
+        assert rows["inproc_soak_slo_breaches"]["status"] == "regressed"
+        assert rows["inproc_soak_commit_p99_s"]["status"] == "regressed"
+        table = trajectory(runs, labels)
+        assert "inproc_soak_slo_breaches" in table and "r03" in table
+        hist_ok = os.path.join(d, "hist_ok.jsonl")
+        with open(hist_ok, "w") as f:
+            for label, breaches in (("r01", 6.0), ("r02", 2.0),
+                                    ("r03", 1.0)):
+                f.write(json.dumps({"label": label, "metrics": [
+                    {"metric": "inproc_soak_slo_breaches",
+                     "value": breaches, "unit": "breaches"}]}) + "\n")
+        assert main(["--history", hist_ok]) == 0
+        hist_one = os.path.join(d, "hist_one.jsonl")
+        with open(hist_one, "w") as f:
+            f.write(json.dumps({"label": "r01", "metrics": [
+                {"metric": "inproc_soak_slo_breaches",
+                 "value": 0.0, "unit": "breaches"}]}) + "\n")
+        assert main(["--history", hist_one]) == 0
+        # a bare row list per line is accepted with generated labels
+        hist_bare = os.path.join(d, "hist_bare.jsonl")
+        with open(hist_bare, "w") as f:
+            f.write(json.dumps([{"metric": "lightserve_p99_s",
+                                 "value": 0.01, "unit": "s"}]) + "\n")
+            f.write(json.dumps([{"metric": "lightserve_p99_s",
+                                 "value": 0.09, "unit": "s"}]) + "\n")
+        assert main(["--history", hist_bare]) == 1
         # the driver's record format ({"tail": jsonl}) parses identically
         drv = os.path.join(d, "driver.json")
         with open(drv, "w") as f:
@@ -586,7 +725,8 @@ def self_test() -> int:
         import shutil
 
         shutil.rmtree(d, ignore_errors=True)
-    print("bench_compare self-test OK (gates, thresholds, formats)")
+    print("bench_compare self-test OK (gates, thresholds, formats, "
+          "history trends)")
     return 0
 
 
@@ -602,18 +742,36 @@ def main(argv=None) -> int:
                     default=DEFAULT_THRESHOLD)
     ap.add_argument("--json", action="store_true",
                     help="print the comparison rows as JSON")
+    ap.add_argument("--history", metavar="PATH",
+                    help="cross-run history file (JSONL, one run per "
+                         "line; tools/soak.py --history appends these): "
+                         "render the whole trajectory and gate the "
+                         "newest entry against the one before it")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
-    if len(args.runs) < 2:
-        ap.error("need at least two run files (or --self-test)")
     try:
         thresholds = parse_thresholds(args.threshold)
-        runs = [load_bench(p) for p in args.runs]
+        if args.history:
+            if args.runs:
+                ap.error("--history takes no positional run files")
+            labels, runs = load_history(args.history)
+        else:
+            if len(args.runs) < 2:
+                ap.error("need at least two run files "
+                         "(or --history / --self-test)")
+            labels, runs = list(args.runs), [load_bench(p)
+                                             for p in args.runs]
     except (ValueError, OSError) as e:
         print(f"bench_compare: {e}", file=sys.stderr)
         return 2
+    if len(runs) < 2:
+        # a one-entry history has nothing to gate yet: render it and
+        # leave clean — the SECOND run is when the trend line starts
+        print(trajectory(runs, labels))
+        print("\nOK: single history entry, nothing to gate yet")
+        return 0
     rows = compare(runs[-2], runs[-1], thresholds, args.default_threshold)
     bad = [r for r in rows
            if r["status"] in ("regressed", "missing", "errored")]
@@ -621,7 +779,7 @@ def main(argv=None) -> int:
         print(json.dumps({"rows": rows, "regressions": len(bad)}, indent=2))
         return 1 if bad else 0
     if len(runs) > 2:
-        print(trajectory(runs, list(args.runs)))
+        print(trajectory(runs, labels))
         print()
     print(render(rows))
     print()

@@ -127,6 +127,7 @@ SITES = {
     "statesync.lying_chunk": False,
     "statesync.lying_snapshot": False,
     "blocksync.bad_block": True,
+    "lightserve.lying_server": False,
     "combo.maverick_corrupt": True,
     # churn cells (membership change as the fault; tools/churn.py rig)
     "churn.flap": True,
@@ -855,6 +856,89 @@ def cell_blocksync_bad_block(seed: int) -> None:
     assert strikes > 0, "victim never struck a lying provider"
 
 
+def cell_lightserve_lying_server(seed: int) -> None:
+    """A serving node armed with ``lightserve.lying_server`` swaps served
+    headers for a re-signed equivocation fork (same keys, different
+    app_hash — it VERIFIES); a bisecting light-client fleet sharing one
+    scoreboard catches the lie by witness cross-check, strikes the liar
+    severely (instant ban), and honest serving continues for the rest of
+    the fleet. Replay: same seed => identical injection count."""
+    import asyncio
+    import copy
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_light_client import CHAIN, T0, _keys, _mk_chain, _resign
+    from tendermint_tpu.libs.faults import faults
+    from tendermint_tpu.libs.peerscore import PeerScoreboard
+    from tendermint_tpu.light import LightClient, TrustOptions
+    from tendermint_tpu.light.client import DivergenceError
+    from tendermint_tpu.light.serve import TAMPER_SITE, ServeProvider
+
+    os.environ.setdefault("TMTPU_BATCH_BACKEND", "host")
+    # validator rotation at height 5 forces the fleet to bisect: many
+    # heights served, many chances for the armed site to lie
+    a, b = _keys(0x50, 4), _keys(0x60, 4)
+    key_sets = [a, a, a, a, b, b, b, b, b, b]
+    honest = _mk_chain(key_sets, 10)
+    forged = copy.deepcopy(honest)
+    for h in forged:
+        forged[h].signed_header.header.app_hash = b"\xee" * 32
+    # _resign needs one key list per height: rebuild per rotated set
+    lo = _resign({h: forged[h] for h in range(1, 5)}, a)
+    hi = _resign({h: forged[h] for h in range(5, 11)}, b)
+    forged = {**lo, **hi}
+    now = T0 + 100 * 1_000_000_000
+
+    def run_fleet():
+        primary = ServeProvider(CHAIN, honest, name="primary")
+        liar = ServeProvider(CHAIN, honest,
+                             forged={h: forged[h] for h in range(2, 11)},
+                             name="liar")
+        witnesses = [liar, ServeProvider(CHAIN, honest, name="honest-a"),
+                     ServeProvider(CHAIN, honest, name="honest-b")]
+        sb = PeerScoreboard(name="light", seed=seed)
+        trust = TrustOptions(3600.0, 1,
+                             honest[1].signed_header.header.hash())
+
+        async def run():
+            caught = 0
+            for _ in range(3):  # the fleet: one scoreboard, fresh clients
+                client = LightClient(CHAIN, trust, primary, witnesses,
+                                     scoreboard=sb)
+                try:
+                    lb = await client.verify_light_block_at_height(
+                        10, now_ns=now)
+                    assert lb.signed_header.header.height == 10
+                except DivergenceError as e:
+                    assert e.witness_id == "liar", e
+                    caught += 1
+            return caught
+
+        caught = asyncio.run(run())
+        return caught, sb, liar
+
+    faults.configure(f"{TAMPER_SITE}@0.75", seed=seed)
+    caught1, sb, liar = run_fleet()
+    fires1 = faults.fires(TAMPER_SITE)
+    assert fires1 > 0, "lying site never fired"
+    assert caught1 >= 1, "no client ever caught the liar"
+    assert sb.banned("liar"), f"liar not banned: {sb.snapshot()}"
+    assert not sb.banned("honest-a") and not sb.banned("honest-b")
+    assert liar.evidence, "divergence evidence never reported"
+    # honest serving continued: with the liar banned (skipped on
+    # cross-check) at least one later client completed the bisection
+    assert caught1 < 3, "serving never recovered after the ban"
+    # replayability: same seed, fresh plane -> identical injection count
+    faults.reset()
+    faults.configure(f"{TAMPER_SITE}@0.75", seed=seed)
+    caught2, sb2, _ = run_fleet()
+    fires2 = faults.fires(TAMPER_SITE)
+    assert (fires2, caught2) == (fires1, caught1), \
+        f"replay diverged: {(fires1, caught1)} != {(fires2, caught2)}"
+    assert sb2.banned("liar")
+    faults.reset()
+
+
 def _churn_mod():
     sys.path.insert(0, os.path.join(REPO, "tools"))
     import churn
@@ -1272,6 +1356,7 @@ CELLS = {
     "statesync.lying_chunk": cell_statesync_lying_chunk,
     "statesync.lying_snapshot": cell_statesync_lying_snapshot,
     "blocksync.bad_block": cell_blocksync_bad_block,
+    "lightserve.lying_server": cell_lightserve_lying_server,
     "combo.maverick_corrupt": cell_combo_maverick_corrupt,
     "churn.flap": cell_churn_flap,
     "churn.rotate": cell_churn_rotate,
@@ -1347,6 +1432,9 @@ def self_test() -> None:
     cell_statesync_lying_chunk(seed=1)
     faults.reset()
     cell_statesync_lying_snapshot(seed=1)
+    faults.reset()
+    # the lying light-server cell is jax-free (host-path ed25519): run it
+    cell_lightserve_lying_server(seed=1)
     faults.reset()
     # churn plumbing: the plan the churn cells execute is deterministic
     churn = _churn_mod()

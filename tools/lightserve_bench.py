@@ -1,0 +1,171 @@
+"""Light-client serving-plane bench + stdlib-only self-test.
+
+    python tools/lightserve_bench.py               # the serving A/B
+    python tools/lightserve_bench.py --self-test   # pure planning math
+
+The bench mode delegates to bench.py's lightserve helpers so this tool and
+``python bench.py --config lightserve`` measure the IDENTICAL code path
+(VerifyCoalescer batching a client fleet vs one scalar verifier.verify per
+request). Rows use the same JSONL contract as bench.py.
+
+The self-test needs NOTHING beyond the stdlib: it loads
+``tendermint_tpu/light/serve.py`` by file path (the module keeps its
+package imports lazy for exactly this) and checks the pure planning
+contracts — the flush schedule the coalescer implements, the bisection
+skeleton the prefetcher pins, the bounded fan-out queue math the ws plane
+enforces, and the token-bucket/cache/limiter semantics — fast enough for
+tools/selfcheck.py's per-tool timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SERVE_PY = os.path.join(REPO, "tendermint_tpu", "light", "serve.py")
+
+
+def _emit(metric: str, value: float, unit: str, vs_baseline: float, **extra):
+    line = {"metric": metric, "value": round(value, 3), "unit": unit,
+            "vs_baseline": round(vs_baseline, 3)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _load_serve_standalone():
+    """serve.py by file path — no package import, no third-party deps."""
+    spec = importlib.util.spec_from_file_location("_lightserve_solo", SERVE_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def self_test() -> int:
+    serve = _load_serve_standalone()
+
+    # flush planning: the pure spec VerifyCoalescer implements
+    assert serve.plan_flushes([0.0, 0.001, 0.002], 0.005, 64) == [(0.005, 3)]
+    assert serve.plan_flushes([0.0, 0.001, 0.002], 0.005, 2) == \
+        [(0.001, 2), (0.007, 1)]
+    assert serve.plan_flushes([0.0, 1.0], 0.005, 64) == \
+        [(0.005, 1), (1.005, 1)]
+    assert serve.plan_flushes([], 0.005, 8) == []
+    # size-vs-deadline crossover: a dense burst closes on size, the tail
+    # on deadline — total batched == total arrivals, always
+    arrivals = [i * 0.00005 for i in range(100)] + [1.0]
+    plan = serve.plan_flushes(arrivals, 0.002, 32)
+    assert sum(n for _, n in plan) == len(arrivals), plan
+    assert max(n for _, n in plan) == 32
+
+    # bisection skeleton: breadth-first midpoints, the order a bisecting
+    # client walks the span; deterministic, deduped, capped
+    sk = serve.bisection_skeleton(1, 17)
+    assert sk[0] == 9 and sk[1:3] == [5, 13], sk
+    assert len(sk) == len(set(sk))
+    assert all(1 < h < 17 for h in sk)
+    assert serve.bisection_skeleton(4, 5) == []
+    assert len(serve.bisection_skeleton(1, 1 << 20, cap=16)) == 16
+    assert serve.bisection_skeleton(1, 17) == serve.bisection_skeleton(1, 17)
+
+    # fan-out queue bounds: backlog is capped, overflow evicts
+    assert serve.fanout_queue_plan(10, 10, 4) == (0, False)
+    assert serve.fanout_queue_plan(10, 7, 4) == (3, False)
+    assert serve.fanout_queue_plan(10, 0, 4) == (4, True)
+
+    # token bucket on an injected clock
+    t = [0.0]
+    tb = serve.TokenBucket(rate=2.0, burst=2.0, clock=lambda: t[0])
+    assert tb.allow() and tb.allow() and not tb.allow()
+    t[0] = 0.5
+    assert tb.allow() and not tb.allow()
+
+    # header cache: LRU with pinned skeleton entries, hard capacity
+    c = serve.HeaderCache(capacity=3)
+    c.put(1, "a")
+    c.put(2, "b", pinned=True)
+    c.put(3, "c")
+    assert c.get(1) == "a"
+    c.put(4, "d")
+    assert c.peek(3) is None and c.peek(2) == "b"
+    assert c.stats == {"hits": 1, "misses": 0, "evictions": 1}
+
+    # client limiter: reason-labeled sheds, abuse scoring on a stub board
+    class Board:
+        def __init__(self):
+            self.strikes = {}
+
+        def banned(self, pid):
+            return self.strikes.get(pid, 0) >= 2
+
+        def record_failure(self, pid, reason="error", severe=False):
+            self.strikes[pid] = self.strikes.get(pid, 0) + 1
+
+        def record_success(self, pid):
+            self.strikes[pid] = 0
+
+    t[0] = 0.0
+    lim = serve.ClientLimiter(rate=1.0, burst=1.0, scoreboard=Board(),
+                              clock=lambda: t[0])
+    lim.admit("c")
+    reasons = []
+    for _ in range(3):
+        try:
+            lim.admit("c")
+        except serve.ShedError as e:
+            reasons.append(e.reason)
+    assert reasons == ["client-rate", "client-rate", "banned"], reasons
+
+    print("lightserve_bench self-test OK (flush planning, skeleton math, "
+          "fan-out bounds, cache/limiter semantics — stdlib only)")
+    return 0
+
+
+def run_bench(clients: int, spans: int) -> int:
+    import bench
+
+    blocks = bench._mk_light_serve_chain(16, 12, "lightserve-tool-ed")
+    all_spans = [(1, 12), (2, 12), (1, 8), (3, 10), (2, 9), (4, 11)]
+    use = all_spans[:max(1, min(spans, len(all_spans)))]
+    per_span = max(1, clients // len(use))
+    now_ns = 1_700_000_000_000_000_000 + 100 * 1_000_000_000
+    reqs = bench._lightserve_requests(blocks, use, per_span, now_ns)
+
+    bench._lightserve_run_scalar(reqs)  # warm
+    bench._lightserve_run_coalesced(reqs)
+    sc_wall, sc_lat = bench._lightserve_run_scalar(reqs)
+    co_wall, co_lat, stats = bench._lightserve_run_coalesced(reqs)
+    sc_rate, co_rate = len(reqs) / sc_wall, len(reqs) / co_wall
+    _emit("lightserve_clients_headers_per_sec", co_rate, "headers/s",
+          co_rate / sc_rate, clients=len(reqs), spans=len(use),
+          scalar_headers_per_sec=round(sc_rate, 1),
+          verified_requests=stats["verified_requests"],
+          coalesced_dupes=stats["coalesced_dupes"],
+          batched_sigs=stats["batched_sigs"])
+    _emit("lightserve_p99_s", bench._p99(co_lat), "s",
+          bench._p99(co_lat) / bench._p99(sc_lat),
+          scalar_p99_s=round(bench._p99(sc_lat), 6))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--clients", type=int, default=96,
+                    help="fleet size for the serving A/B")
+    ap.add_argument("--spans", type=int, default=6,
+                    help="distinct (trusted, target) spans the fleet asks")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    return run_bench(args.clients, args.spans)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,7 +87,8 @@ def self_test() -> int:
                      "fleet_scrape.py", "bench_compare.py",
                      "chaos_matrix.py", "device_profile.py",
                      "loadtime.py", "churn.py", "crashmatrix.py",
-                     "aggsig_bench.py", "soak.py"):
+                     "aggsig_bench.py", "soak.py",
+                     "lightserve_bench.py"):
         assert expected in tools, (expected, tools)
     assert os.path.basename(__file__) not in tools  # no recursion
     # prove the runner distinguishes pass from fail without running the
