@@ -1544,6 +1544,41 @@ def bench_soak():
             _emit(m, 0.0, "error", 0.0, error=f"{type(e).__name__}: {e}")
 
 
+def bench_wan():
+    """Config wan: the degraded-network plane (tools/quorum_loss.py). Two
+    gated rows: 4-validator commit throughput under the seeded ``wan``
+    link profile (80-160ms asymmetric latency + jitter on every link;
+    higher-better "commits/min"), and worst-case quorum-loss recovery —
+    >1/3 of voting power isolated until the fleet halts with
+    ``halt_reason="quorum_lost"``, then healed; the row is the worst
+    heal->next-commit time across windows (lower-better "s"). Both runs
+    also assert the safety half (no conflicting commits, no double-sign
+    evidence, hash-identical history), so a regression that trades
+    safety for speed errors the row instead of improving it."""
+    ql = _tools_mod("quorum_loss")
+    try:
+        rep = ql.run_wan(seed=1, blocks=12)
+        _emit("inproc_wan4_commits_per_min", float(rep["commits_per_min"]),
+              "commits/min", 0.0, seed=rep["seed"], blocks=rep["blocks"],
+              applied_links=rep["applied_links"],
+              elapsed_s=rep["elapsed_s"])
+    except Exception as e:
+        _emit("inproc_wan4_commits_per_min", 0.0, "error", 0.0,
+              error=f"{type(e).__name__}: {e}")
+    try:
+        rep = ql.run_quorum_loss(seed=1, windows=2)
+        _emit("inproc_quorumloss_recover_s", float(rep["recover_max_s"]),
+              "s", 0.0, seed=rep["seed"], windows=rep["windows"],
+              recover_s=[w["recover_s"] for w in rep["windows_run"]],
+              halt_heights=[w["halt_height"] for w in rep["windows_run"]],
+              hash_identical=rep["hash_identical"],
+              equivocations=rep["equivocations"],
+              outcome_fingerprint=rep["outcome_fingerprint"])
+    except Exception as e:
+        _emit("inproc_quorumloss_recover_s", 0.0, "error", 0.0,
+              error=f"{type(e).__name__}: {e}")
+
+
 def _mk_light_serve_chain(n_vals: int, n_heights: int, chain_id: str,
                           scheme: str = "ed25519"):
     """Signed LightBlock chain for the serving-plane A/B: real headers
@@ -1776,6 +1811,7 @@ CONFIGS = {
     "aggsig": bench_aggsig,
     "lightserve": bench_lightserve,
     "soak": bench_soak,
+    "wan": bench_wan,
     "10k": bench_verify_commit_10k,
 }
 
@@ -1822,8 +1858,8 @@ if __name__ == "__main__":
             # relay occasionally drops a compile mid-flight — retry each
             # config once before reporting it failed.
             for key in ("2", "3", "4", "ingest", "churn", "crash", "exec",
-                        "aggsig", "lightserve", "soak", "5", "1", "multichip",
-                        "10k"):
+                        "aggsig", "lightserve", "soak", "wan", "5", "1",
+                        "multichip", "10k"):
                 for attempt in (1, 2):
                     try:
                         with _tracer.span(f"config_{key}"):

@@ -443,15 +443,24 @@ class ConsensusReactor(Reactor):
 
     def _maybe_refresh_peer(self, ps: PeerState) -> None:
         """Self-healing gossip: if the peer has been silent past
-        gossip_stall_refresh_s AND is behind us, clear its delivery
-        bitmaps so both gossip routines re-send (see
-        PeerState.refresh_if_stalled). The behind-gate keeps a healthy
-        net that idles between txs quiet — peers at our height need
-        nothing re-sent (same-height wedges clear themselves through
-        round timeouts, which reset the per-round vote bitmaps via
-        NewRoundStep) — while the post-heal case this exists for (a
-        partitioned peer stuck below our height) always qualifies."""
-        if ps.prs.height >= self.cs.rs.height:
+        gossip_stall_refresh_s AND could still need something from us,
+        clear its delivery bitmaps so both gossip routines re-send (see
+        PeerState.refresh_if_stalled). A peer behind our height always
+        qualifies (the classic post-heal catchup case). A peer AT our
+        height qualifies only while we are inside an active round
+        ourselves: a healed quorum-loss window leaves every node wedged
+        at the same height in PREVOTE/PRECOMMIT — a step with NO timeout
+        until 2/3-any arrives, so the "round timeouts reset the vote
+        bitmaps via NewRoundStep" escape hatch never fires and the
+        delivery bitmaps (poisoned by sends the blocked links ate) wedge
+        the fleet permanently. The NEW_HEIGHT/COMMIT exclusion keeps a
+        healthy net that idles between txs quiet: idle peers sit at
+        NEW_HEIGHT needing nothing re-sent."""
+        rs = self.cs.rs
+        if ps.prs.height > rs.height:
+            return
+        if (ps.prs.height == rs.height
+                and rs.step in (RoundStep.NEW_HEIGHT, RoundStep.COMMIT)):
             return
         if ps.refresh_if_stalled(self.cs.config.gossip_stall_refresh_s):
             m = self.cs.metrics

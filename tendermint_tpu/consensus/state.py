@@ -147,6 +147,21 @@ class ConsensusState:
         # clock reads and dict stores per stage per height.
         from .timeline import StageTimeline
         self.timeline = StageTimeline()
+        # adaptive round timeouts (opt-in, config.timeout_mode): a pure
+        # EWMA fold over the timeline's sealed per-height durations —
+        # spec mode leaves self.adaptive None and every timeout lookup
+        # byte-identical to the fixed schedule
+        config.validate_timeout_mode()
+        self.adaptive = None
+        if config.timeout_mode == "adaptive":
+            from .config import AdaptiveTimeouts
+            self.adaptive = AdaptiveTimeouts(config)
+            self.timeline.on_seal = self.adaptive.observe
+        # seeded clock-skew plane (libs/faults.py "clock.skew"): this
+        # node's deterministic wall-clock offset, threaded through the
+        # consensus-visible timestamps via _now_ns; assigned when the priv
+        # validator is wired (its address is the stable per-node identity)
+        self.clock_skew_ns = 0
         # byzantine test hooks (the reference's maverick node,
         # test/maverick/consensus/misbehavior.go): height -> behavior name.
         # Supported: "double-prevote" (equivocate at prevote). Only MockPV
@@ -173,9 +188,36 @@ class ConsensusState:
         self.priv_validator = pv
         if pv is not None:
             self.priv_validator_pub_key = pv.get_pub_key()
+            from ..libs.faults import faults
+            if faults.armed("clock.skew"):
+                ident = self.priv_validator_pub_key.address().hex()
+                self.clock_skew_ns = faults.skew_ns("clock.skew", ident)
 
     def set_event_bus(self, bus: EventBus) -> None:
         self.event_bus = bus
+
+    def _now_ns(self) -> int:
+        """Wall clock as THIS node sees it: now_ns() plus the node's
+        deterministic clock.skew offset. Only consensus-VISIBLE timestamps
+        (votes, proposals, commit time) read the skewed clock — WAL
+        records and timeout scheduling stay on the unskewed local clock,
+        mirroring a real deployment where a skewed clock changes what a
+        node claims, not how fast its timers run."""
+        return now_ns() + self.clock_skew_ns
+
+    def _round_timeout_s(self, kind: str, round_: int) -> float:
+        """Round timeout per config.timeout_mode: the fixed spec schedule
+        (``config.propose/prevote/precommit``) or the adaptive
+        controller's clamped EWMA baseline plus the same per-round delta."""
+        if self.adaptive is not None:
+            return self.adaptive.timeout(kind, round_)
+        return getattr(self.config, kind)(round_)
+
+    def _note_round_advance(self, reason: str) -> None:
+        """Degraded-network telemetry: count a round-escalation event
+        (series tendermint_consensus_round_advances_total{reason})."""
+        if self.metrics is not None:
+            self.metrics.round_advances_total.labels(reason).inc()
 
     # -- external input (reactor → queues) ---------------------------------
 
@@ -466,14 +508,17 @@ class ConsensusState:
         elif step == RoundStep.PROPOSE:
             if self.event_bus:
                 self.event_bus.publish_event_timeout_propose(self._round_state_event())
+            self._note_round_advance("timeout_propose")
             self._enter_prevote(ti.height, ti.round)
         elif step == RoundStep.PREVOTE_WAIT:
             if self.event_bus:
                 self.event_bus.publish_event_timeout_wait(self._round_state_event())
+            self._note_round_advance("timeout_prevote")
             self._enter_precommit(ti.height, ti.round)
         elif step == RoundStep.PRECOMMIT_WAIT:
             if self.event_bus:
                 self.event_bus.publish_event_timeout_wait(self._round_state_event())
+            self._note_round_advance("timeout_precommit")
             self._enter_precommit(ti.height, ti.round)
             self._enter_new_round(ti.height, ti.round + 1)
         else:
@@ -650,8 +695,8 @@ class ConsensusState:
             return
         logger.debug("entering propose %d/%d", height, round_)
         try:
-            self._schedule_timeout(self.config.propose(round_), height, round_,
-                                   RoundStep.PROPOSE)
+            self._schedule_timeout(self._round_timeout_s("propose", round_),
+                                   height, round_, RoundStep.PROPOSE)
             if self.priv_validator is None or self.priv_validator_pub_key is None:
                 return
             address = self.priv_validator_pub_key.address()
@@ -685,7 +730,8 @@ class ConsensusState:
                 height, self.state, commit, proposer_addr)
 
         block_id = BlockID(block.hash(), block_parts.header())
-        proposal = Proposal(height, round_, rs.valid_round, block_id, now_ns())
+        proposal = Proposal(height, round_, rs.valid_round, block_id,
+                            self._now_ns())
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
         except Exception as e:
@@ -798,7 +844,7 @@ class ConsensusState:
             seen_ts = [v.timestamp_ns for v in self.rs.last_commit.list_votes()
                        if v.block_id == commit.block_id
                        and commit.signers.get_index(v.validator_index)]
-        check_aggregated_commit_time(commit, seen_ts, now_ns(),
+        check_aggregated_commit_time(commit, seen_ts, self._now_ns(),
                                      int(drift_s * 1e9))
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
@@ -815,8 +861,8 @@ class ConsensusState:
         rs.round = round_
         rs.step = RoundStep.PREVOTE_WAIT
         self._new_step()
-        self._schedule_timeout(self.config.prevote(round_), height, round_,
-                               RoundStep.PREVOTE_WAIT)
+        self._schedule_timeout(self._round_timeout_s("prevote", round_),
+                               height, round_, RoundStep.PREVOTE_WAIT)
 
     def _enter_precommit(self, height: int, round_: int) -> None:
         """(state.go:1322)"""
@@ -905,8 +951,8 @@ class ConsensusState:
         logger.debug("entering precommit wait %d/%d", height, round_)
         rs.triggered_timeout_precommit = True
         self._new_step()
-        self._schedule_timeout(self.config.precommit(round_), height, round_,
-                               RoundStep.PRECOMMIT_WAIT)
+        self._schedule_timeout(self._round_timeout_s("precommit", round_),
+                               height, round_, RoundStep.PRECOMMIT_WAIT)
 
     def _enter_commit(self, height: int, commit_round: int) -> None:
         """(state.go:1476)"""
@@ -937,7 +983,7 @@ class ConsensusState:
             # keep rs.round; commit_round points at the right precommit set
             rs.step = RoundStep.COMMIT
             rs.commit_round = commit_round
-            rs.commit_time_ns = now_ns()
+            rs.commit_time_ns = self._now_ns()
             self._new_step()
             self._try_finalize_commit(height)
 
@@ -985,6 +1031,7 @@ class ConsensusState:
 
         if self.metrics is not None:
             self._record_commit_metrics(block)
+            self.metrics.rounds_per_height.observe(rs.commit_round + 1)
 
         if self.block_store.height() < block.header.height:
             seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
@@ -1183,6 +1230,7 @@ class ConsensusState:
                         self.event_bus.publish_event_valid_block(self._round_state_event())
 
             if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._note_round_advance("polka_skip")
                 self._enter_new_round(height, vote.round)
             elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
                 block_id, ok = prevotes.two_thirds_majority()
@@ -1211,6 +1259,8 @@ class ConsensusState:
                 else:
                     self._enter_precommit_wait(height, vote.round)
             elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                if rs.round < vote.round:
+                    self._note_round_advance("polka_skip")
                 self._enter_new_round(height, vote.round)
                 self._enter_precommit_wait(height, vote.round)
         else:
@@ -1220,8 +1270,11 @@ class ConsensusState:
     # -- signing -----------------------------------------------------------
 
     def _vote_time_ns(self) -> int:
-        """(state.go:2204 voteTime) — BFT time monotonicity."""
-        now = now_ns()
+        """(state.go:2204 voteTime) — BFT time monotonicity. Reads the
+        skewed clock (_now_ns): a node with a fast/slow wall clock stamps
+        its votes accordingly, and the max() against the locked/proposal
+        block time keeps BFT-time monotone regardless of the skew sign."""
+        now = self._now_ns()
         min_vote_time = now
         time_iota_ns = self.state.consensus_params.block.time_iota_ms * 1_000_000
         if self.rs.locked_block is not None:

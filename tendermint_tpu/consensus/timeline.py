@@ -61,6 +61,10 @@ class StageTimeline:
         self.capacity = capacity
         self._ring: "collections.deque" = collections.deque(maxlen=capacity)
         self.metrics = None  # ConsensusMetrics, wired by the node
+        #: seal observer: called with the sealed height's durations dict
+        #: from inside the single-writer loop — the adaptive-timeout
+        #: controller's observation stream (consensus/config.py)
+        self.on_seal = None
         self._cur: Optional[dict] = None
         self.heights_sealed = 0
         #: replay guard: WAL catchup re-feeds old messages through the
@@ -151,6 +155,9 @@ class StageTimeline:
         if m is not None:
             for stage, d in durations.items():
                 m.stage_seconds.labels(stage).observe(d)
+        cb = self.on_seal
+        if cb is not None:
+            cb(dict(durations))
         if tracer.enabled:
             prev = cur["t0_perf"]
             for stage in STAGES:

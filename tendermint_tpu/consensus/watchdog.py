@@ -14,6 +14,18 @@ committed height; when it hasn't advanced for ``stall_timeout_s`` the node
 One dump per stall episode: the watchdog re-arms only after the height
 moves again. Enabled via ``consensus.stall_watchdog_s`` (0 = off, the
 default — a chain configured to idle between txs would false-positive).
+
+Halt classification: not every stall is a mystery. During a quorum-loss
+window (>1/3 of voting power isolated) a halt is the EXPECTED,
+liveness-only consequence — Tendermint's safety argument requires it.
+``classify_halt`` reads the current round's vote-set voting power and
+per-validator vote bitmaps: when the power absent from the stage
+blocking the round (the prevote set until it holds >2/3, the precommit
+set after) exceeds 1/3 of the total, the episode is reported as
+``halt_reason="quorum_lost"`` (with the missing power and the bitmap in
+the log line and the debugdump bundle) instead of the generic
+``"stalled"`` — so an intentional isolation window produces an
+attributable record, not an uninformative stall bundle.
 """
 
 from __future__ import annotations
@@ -50,6 +62,8 @@ class ConsensusWatchdog:
                                  is not None
                                  else max(0.25, stall_timeout_s / 4))
         self.stalls = 0            # episodes observed (tests read this)
+        self.last_halt_reason: Optional[str] = None  # "stalled"/"quorum_lost"
+        self.last_halt_detail: dict = {}
         self.last_dump_path: Optional[str] = None
         self._task: Optional[asyncio.Task] = None
         self._last_height = -1
@@ -95,12 +109,88 @@ class ConsensusWatchdog:
                 self.stalls += 1
                 self._report(h, now - self._last_advance_t)
 
+    def classify_halt(self) -> "tuple[str, dict]":
+        """Classify the current halt from the live round's vote sets:
+        ``("quorum_lost", detail)`` when the voting power absent from the
+        stage blocking the round exceeds 1/3 of the total (no quorum can
+        form — the expected consequence of an isolation window), else
+        ``("stalled", detail)``. ``detail`` carries the blocking stage
+        and the per-validator vote bitmap rows for the debugdump bundle;
+        it is empty only when the round state isn't inspectable."""
+        rs = getattr(self.cs, "rs", None)
+        votes = getattr(rs, "votes", None)
+        vals = getattr(rs, "validators", None)
+        if rs is None or votes is None or vals is None:
+            return "stalled", {}
+        round_ = getattr(rs, "round", 0)
+        try:
+            prevotes = votes.prevotes(round_)
+            precommits = votes.precommits(round_)
+            total = vals.total_voting_power()
+            if not total:
+                return "stalled", {}
+            pv_power = prevotes.sum if prevotes is not None else 0
+            pc_power = precommits.sum if precommits is not None else 0
+            pv_bits = prevotes.bit_array() if prevotes is not None else None
+            pc_bits = (precommits.bit_array()
+                       if precommits is not None else None)
+            rows = []
+            for i, val in enumerate(vals.validators):
+                rows.append({
+                    "index": i,
+                    "address": val.address.hex(),
+                    "power": val.voting_power,
+                    "prevote": bool(pv_bits is not None
+                                    and pv_bits.get_index(i)),
+                    "precommit": bool(pc_bits is not None
+                                      and pc_bits.get_index(i)),
+                })
+        except Exception:
+            logger.exception("halt classification failed; "
+                             "falling back to generic stall")
+            return "stalled", {}
+        # the missing power is measured against the stage BLOCKING the
+        # round, not the best-populated set: a cut landing between the
+        # prevote and precommit quorums leaves a full prevote set behind
+        # (delivered pre-cut) while the precommits can never reach 2/3 —
+        # that window is still a quorum loss
+        if pv_power * 3 > total * 2:
+            blocking, present = "precommit", pc_power
+        else:
+            blocking, present = "prevote", pv_power
+        missing = total - present
+        detail = {
+            "height": getattr(rs, "height", -1),
+            "round": round_,
+            "total_power": total,
+            "prevote_power": pv_power,
+            "precommit_power": pc_power,
+            "blocking_stage": blocking,
+            "missing_power": missing,
+            "validators": rows,
+        }
+        reason = "quorum_lost" if missing * 3 > total else "stalled"
+        return reason, detail
+
     def _report(self, height: int, idle_s: float) -> None:
         rs = getattr(self.cs, "rs", None)
-        logger.critical(
-            "consensus stalled: no commit for %.1fs (height=%d round=%s "
-            "step=%s)", idle_s, height,
-            getattr(rs, "round", "?"), getattr(rs, "step", "?"))
+        reason, detail = self.classify_halt()
+        self.last_halt_reason = reason
+        self.last_halt_detail = detail
+        if reason == "quorum_lost":
+            logger.critical(
+                "consensus halted, quorum lost: no commit for %.1fs "
+                "(height=%d round=%s step=%s) — %d/%d voting power "
+                "missing from the round's vote sets (>1/3); liveness "
+                "halt is EXPECTED until the power returns",
+                idle_s, height, getattr(rs, "round", "?"),
+                getattr(rs, "step", "?"), detail.get("missing_power", -1),
+                detail.get("total_power", -1))
+        else:
+            logger.critical(
+                "consensus stalled: no commit for %.1fs (height=%d round=%s "
+                "step=%s)", idle_s, height,
+                getattr(rs, "round", "?"), getattr(rs, "step", "?"))
         if self.metrics is not None:
             self.metrics.consensus_stalled_total.inc()
         if self.dump_dir:
@@ -108,14 +198,17 @@ class ConsensusWatchdog:
                 from ..libs.debugdump import write_dump
 
                 out = os.path.join(self.dump_dir,
-                                   f"debug-stall-{int(time.time())}")
+                                   f"debug-{reason.replace('_', '-')}-"
+                                   f"{int(time.time())}")
                 try:
                     loop = asyncio.get_running_loop()
                 except RuntimeError:
                     loop = None
-                self.last_dump_path = write_dump(out, node=self.dump_node,
-                                                 loop=loop)
-                logger.critical("stall debugdump written to %s",
+                self.last_dump_path = write_dump(
+                    out, node=self.dump_node, loop=loop,
+                    extras={"halt_reason": reason, "idle_s": round(idle_s, 3),
+                            "halt_detail": detail})
+                logger.critical("%s debugdump written to %s", reason,
                                 self.last_dump_path)
             except Exception:
                 logger.exception("failed writing stall debugdump")

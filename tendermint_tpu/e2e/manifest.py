@@ -50,8 +50,20 @@ Perturbation semantics: kill/pause/restart match the reference's
 subprocess nets have no network namespace to unplug, so it is a long
 SIGSTOP: peers drop the frozen node on ping timeout and re-dial after
 SIGCONT. One-way partitions and asymmetric connectivity are NOT
-representable; the reference uses docker network disconnect
-(perturb.go:48) for true partitions.
+representable over subprocess TCP; the reference uses docker network
+disconnect (perturb.go:48) for true partitions. The IN-PROC plane does
+represent them (p2p.inproc LINK_PROFILES / partition_oneway), and the
+manifest mirrors the profile grammar so the same degraded-network intent
+validates in both worlds:
+
+    link_profile = "wan"        # "" | wan | gray | asym — named profile
+                                # from p2p.inproc.LINK_PROFILES, planned
+                                # per directed link by plan_link_profiles
+    link_profile_seed = 0       # planner + per-link policy RNG seed
+
+A subprocess runner that cannot emulate the profile must reject the
+manifest rather than silently run it clean (validated here either way, so
+a typo'd profile fails at load, not mid-run).
 """
 
 from __future__ import annotations
@@ -169,6 +181,10 @@ class Manifest:
     topology: str = "full_mesh"
     sparse_degree: int = 3
     topology_seed: int = 0
+    # degraded-network plane: a named link profile (p2p.inproc
+    # LINK_PROFILES) planned per directed link from one seed; "" = clean
+    link_profile: str = ""
+    link_profile_seed: int = 0
     validators: Dict[str, int] = field(default_factory=dict)
     nodes: List[NodeManifest] = field(default_factory=list)
 
@@ -210,6 +226,8 @@ class Manifest:
             topology=doc.get("topology", "full_mesh"),
             sparse_degree=int(doc.get("sparse_degree", 3)),
             topology_seed=int(doc.get("topology_seed", 0)),
+            link_profile=doc.get("link_profile", ""),
+            link_profile_seed=int(doc.get("link_profile_seed", 0)),
             validators={k: int(v) for k, v in doc.get("validators", {}).items()},
             nodes=nodes,
         )
@@ -234,6 +252,15 @@ class Manifest:
         if self.topology not in TOPOLOGIES:
             raise ValueError(f"unknown topology {self.topology!r}; "
                              f"known: {TOPOLOGIES}")
+        if self.link_profile:
+            from ..p2p.inproc import LINK_PROFILES
+
+            if self.link_profile not in LINK_PROFILES:
+                # a typo'd profile would run the net clean and pass the
+                # degradation cell vacuously — reject at load
+                raise ValueError(
+                    f"unknown link profile {self.link_profile!r}; "
+                    f"known: {sorted(LINK_PROFILES)}")
         if self.sparse_degree < 1:
             raise ValueError("sparse_degree must be >= 1")
         if self.topology == "seed" and not any(n.seed_node

@@ -42,9 +42,21 @@ _TIMELINE_TAIL_HEIGHTS = 32
 _METRICS_RENDER_TIMEOUT_S = 2.0
 
 
-def write_dump(out_dir: str, node=None, loop=None) -> str:
-    """Write stacks + node state under out_dir; returns the dump path."""
+def write_dump(out_dir: str, node=None, loop=None, extras=None) -> str:
+    """Write stacks + node state under out_dir; returns the dump path.
+    ``extras`` is an optional JSON-safe dict the caller wants in the
+    bundle (``extras.json``) — e.g. the watchdog's halt classification
+    and per-validator vote bitmap."""
     os.makedirs(out_dir, exist_ok=True)
+
+    if extras:
+        try:
+            import json
+
+            with open(os.path.join(out_dir, "extras.json"), "w") as f:
+                json.dump(extras, f, indent=1, default=str)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
 
     with open(os.path.join(out_dir, "threads.txt"), "w") as f:
         for tid, frame in sys._current_frames().items():

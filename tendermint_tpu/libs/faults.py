@@ -42,6 +42,11 @@ Known sites (the catalog; see README "Fault injection & chaos testing"):
 * ``db.write_batch``      — KV write batches: BufferedDB window flush and
                             SQLiteDB write_batch (libs/db.py)
 * ``net.drop``            — in-proc transport delivery (p2p/inproc.py)
+* ``clock.skew``          — per-node deterministic wall-clock offset for
+                            vote/proposal timestamping (consensus/state.py;
+                            value-returning — consulted via ``skew_ns``,
+                            the ``@prob`` modifier scales the ±500ms
+                            magnitude window instead of gating firing)
 
 Content-corruption sites (the adversarial plane — ``mutate`` flips a
 deterministically-chosen bit instead of raising, so the victim's REAL
@@ -98,6 +103,9 @@ KNOWN_SITES = frozenset({
     "wal.fsync",
     "db.write_batch",
     "net.drop",
+    # seeded per-node clock skew (consensus timestamping); value-returning
+    # via skew_ns(), not a fire()-gated raise
+    "clock.skew",
     # conflict-group mis-assignment (state/parallel.py): a fired trigger
     # tosses a tx into a deliberately wrong speculation lane, forcing the
     # validation + re-execution machinery to earn the byte-parity
@@ -371,6 +379,36 @@ class FaultPlane:
         if m is not None:
             m.faults_injected_total.labels(site).inc()
         return cut
+
+    def skew_ns(self, site: str, ident: str,
+                max_abs_ns: int = 500_000_000) -> int:
+        """Value-returning seam for clock-skew sites: a deterministic
+        signed offset in [-max_abs_ns, +max_abs_ns] nanoseconds for
+        ``ident`` (node name / validator address) when ``site`` is armed,
+        0 otherwise. The offset is a pure function of (seed, site, ident)
+        — NOT of the site's RNG stream position — so every consultation
+        returns the same value and arming order can't perturb it; two
+        nodes with different idents get different (but each deterministic)
+        offsets from one spec. The ``@prob`` modifier scales the magnitude
+        window (``clock.skew@0.5`` draws from ±max/2) rather than gating
+        firing — a clock is skewed or it isn't, per process."""
+        if not self._sites:
+            return 0
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                return 0
+            st.evals += 1
+            span = int(max_abs_ns * st.prob)
+            seed = self._seed
+            if span <= 0:
+                return 0
+            st.fires += 1
+        m = metrics
+        if m is not None:
+            m.faults_injected_total.labels(site).inc()
+        rng = random.Random(zlib.crc32(f"{seed}|{site}|{ident}".encode()))
+        return rng.randint(-span, span)
 
     # -- introspection (tests / tools) -------------------------------------
 

@@ -353,6 +353,19 @@ class ConsensusMetrics:
             ["stage"],
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0))
+        # -- degraded-network plane (round churn under WAN/gray/asym) ----
+        # reasons: timeout_propose / timeout_prevote (timeout-driven step
+        # escalations that put the round on the nil-vote path),
+        # timeout_precommit (the round actually advances), polka_skip
+        # (2/3-any votes seen at a higher round jump us forward)
+        self.round_advances_total = c(
+            "consensus", "round_advances_total",
+            "Round-escalation events by cause (timeout_propose, "
+            "timeout_prevote, timeout_precommit, polka_skip).", ["reason"])
+        self.rounds_per_height = h(
+            "consensus", "rounds_per_height",
+            "Rounds a height took to commit (1 = no escalation).",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
 
 
 class MempoolMetrics:

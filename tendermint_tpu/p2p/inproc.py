@@ -4,10 +4,22 @@ p2p/test_util.go). The production TCP transport shares the Peer surface.
 
 Chaos controls: every DIRECTED link (a's peer object for b carries the
 a→b direction) can take a :class:`LinkPolicy` — seeded drop / duplicate /
-reorder / delay plus a partition blackhole — so a 4-node consensus net can
-be run under deterministic 10% loss, partitioned, and healed, all inside
-one test. Policies are applied at ``try_send`` time; with no policy the
-path is byte-identical to the original direct enqueue.
+reorder / delay / jitter plus a partition blackhole — so a 4-node
+consensus net can be run under deterministic 10% loss, partitioned, and
+healed, all inside one test. Policies are applied at ``try_send`` time;
+with no policy the path is byte-identical to the original direct enqueue.
+
+Degraded-network profiles: :data:`LINK_PROFILES` names the knob sets for
+the hard regimes the partially-synchronous model actually allows —
+``wan`` (latency + jitter + light loss), ``gray`` (heavy loss, NOT a
+blackhole: some traffic still leaks through, so peers never see a clean
+disconnect), and ``asym`` (one direction degraded while the reverse stays
+clean). :func:`plan_link_profiles` is the pure seeded planner that maps
+every directed link to its knobs — same (ids, profile, seed) → same plan —
+and ``InProcNetwork.apply_link_plan`` attaches it to a live net. One-way
+partitions (``partition_oneway``) and cut-scoped healing (``heal`` with
+groups) round out the plane: healing never replaces policy objects, so
+the surviving direction's RNG stream keeps replaying.
 """
 
 from __future__ import annotations
@@ -34,20 +46,29 @@ class LinkPolicy:
     link sees the same fate every time regardless of scheduling elsewhere.
     ``blocked`` models a network partition: sends are blackholed (the
     sender still sees success — a partitioned wire gives no feedback).
+
+    ``delay_s`` is the base one-way latency; ``jitter_s`` adds a seeded
+    uniform draw in [0, jitter_s) per delivered copy, modeling WAN queueing
+    variance. With ``jitter_s == 0`` the RNG stream is byte-identical to a
+    policy built before jitter existed (no extra draw is consumed), so
+    seeded replays of older schedules still hold.
     """
 
-    __slots__ = ("drop_p", "dup_p", "reorder_p", "delay_s", "blocked",
-                 "rng", "stats")
+    __slots__ = ("drop_p", "dup_p", "reorder_p", "delay_s", "jitter_s",
+                 "blocked", "profile", "rng", "stats")
 
     def __init__(self, src: str = "", dst: str = "", seed: int = 0,
                  drop_p: float = 0.0, dup_p: float = 0.0,
                  reorder_p: float = 0.0, delay_s: float = 0.0,
-                 blocked: bool = False):
+                 jitter_s: float = 0.0, blocked: bool = False,
+                 profile: str = ""):
         self.drop_p = drop_p
         self.dup_p = dup_p
         self.reorder_p = reorder_p
         self.delay_s = delay_s
+        self.jitter_s = jitter_s
         self.blocked = blocked
+        self.profile = profile
         self.rng = random.Random(zlib.crc32(f"{seed}|{src}|{dst}".encode()))
         self.stats = collections.Counter()
 
@@ -70,6 +91,9 @@ class LinkPolicy:
         delays = []
         for _ in range(copies):
             delay = self.delay_s
+            if self.jitter_s:
+                delay += r.uniform(0.0, self.jitter_s)
+                self.stats["jittered"] += 1
             if self.reorder_p and r.random() < self.reorder_p:
                 # hold this copy just long enough for later sends to
                 # overtake it (queue pumps drain in well under a ms)
@@ -80,6 +104,49 @@ class LinkPolicy:
             delays.append(delay)
         self.stats["delivered"] += copies
         return delays
+
+
+#: named knob sets for one DIRECTED link under each degraded-network
+#: profile (the e2e manifest validates against these same names):
+#:   wan   continental RTT with queueing variance and light loss
+#:   gray  heavy loss that still leaks traffic — peers never see a clean
+#:         disconnect, the regime that defeats naive failure detectors
+#:   asym  knobs for the DEGRADED direction of an asymmetric pair; the
+#:         planner leaves the reverse direction clean
+LINK_PROFILES: Dict[str, Dict[str, float]] = {
+    "wan":  {"delay_s": 0.030, "jitter_s": 0.040, "drop_p": 0.01,
+             "reorder_p": 0.05},
+    "gray": {"delay_s": 0.010, "jitter_s": 0.020, "drop_p": 0.60},
+    "asym": {"delay_s": 0.020, "jitter_s": 0.030, "drop_p": 0.45},
+}
+
+
+def plan_link_profiles(ids: List[str], profile: str,
+                       seed: int = 0) -> Dict[Tuple[str, str], Dict]:
+    """Pure seeded planner: map every directed link among ``ids`` to the
+    knob dict it should run under ``profile``. Same (ids, profile, seed) →
+    same plan, independent of any live net. ``wan`` and ``gray`` degrade
+    every direction uniformly; ``asym`` picks — per unordered pair, from
+    the planner RNG — ONE direction to degrade and leaves the reverse
+    clean (absent from the plan). Every knob dict carries ``profile`` so
+    live policies are attributable in stats and fingerprints."""
+    if profile not in LINK_PROFILES:
+        raise ValueError(
+            f"unknown link profile {profile!r}; known: "
+            f"{sorted(LINK_PROFILES)}")
+    knobs = dict(LINK_PROFILES[profile], profile=profile)
+    ids = sorted(ids)
+    plan: Dict[Tuple[str, str], Dict] = {}
+    rng = random.Random(zlib.crc32(f"linkplan|{profile}|{seed}".encode()))
+    for i, a in enumerate(ids):
+        for b in ids[i + 1:]:
+            if profile == "asym":
+                src, dst = (a, b) if rng.random() < 0.5 else (b, a)
+                plan[(src, dst)] = dict(knobs)
+            else:
+                plan[(a, b)] = dict(knobs)
+                plan[(b, a)] = dict(knobs)
+    return plan
 
 
 def sparse_edges(ids: List[str], degree: int = 3,
@@ -307,7 +374,10 @@ class InProcNetwork:
         (stop_peer_for_error); without this, adversarial chaos runs bleed
         connectivity until the net partitions itself. Existing LinkPolicy
         objects (and their RNG streams) carry over to the fresh peers so a
-        seeded chaos schedule survives reconnects. Intentionally-departed
+        seeded chaos schedule survives reconnects — PER DIRECTION: an
+        asymmetric pair (src→dst blocked, dst→src seeded-lossy) rewires
+        with each direction keeping its own policy object, so a one-way
+        partition survives a redial exactly as asymmetric. Intentionally-departed
         nodes (remove_node) are skipped — redialing them would make clean
         leave impossible and mask real link failures in chaos stats.
         Returns pairs rewired."""
@@ -347,6 +417,24 @@ class InProcNetwork:
         for (src, dst) in list(self.links):
             self.set_link_policy(src, dst, seed=seed, drop_p=drop_p, **kw)
 
+    def apply_link_plan(self, plan: Dict[Tuple[str, str], Dict],
+                        seed: int = 0) -> int:
+        """Attach a :func:`plan_link_profiles` plan to the live net: each
+        planned directed link gets a fresh seeded policy with the planned
+        knobs; directed links absent from the plan are left untouched
+        (clean under ``asym``). Returns policies attached."""
+        count = 0
+        for (src, dst), kw in sorted(plan.items()):
+            if (src, dst) in self.links:
+                self.set_link_policy(src, dst, seed=seed, **kw)
+                count += 1
+        return count
+
+    def apply_profile(self, profile: str, seed: int = 0) -> int:
+        """Plan + apply a named profile over every current switch."""
+        plan = plan_link_profiles(sorted(self.switches), profile, seed=seed)
+        return self.apply_link_plan(plan, seed=seed)
+
     def clear_policies(self) -> None:
         for peer in self.links.values():
             peer.policy = None
@@ -367,11 +455,53 @@ class InProcNetwork:
                 else:
                     peer.policy.blocked = True
 
-    def heal(self) -> None:
-        """Unblock every partitioned link (loss/delay knobs survive)."""
-        for peer in self.links.values():
-            if peer.policy is not None:
+    def partition_oneway(self, src_group: Iterable[str],
+                         dst_group: Optional[Iterable[str]] = None) -> int:
+        """Blackhole ONLY the src→dst direction of links crossing the
+        cut — the reverse direction keeps flowing, its policy object (and
+        RNG stream) untouched. This is the asymmetric-connectivity regime
+        TCP-based failure detectors misread: dst still hears from src but
+        src gets no acks back. Returns directed links blocked."""
+        a: Set[str] = set(src_group)
+        b: Set[str] = (set(dst_group) if dst_group is not None
+                       else set(self.switches) - a)
+        count = 0
+        for (src, dst), peer in self.links.items():
+            if src in a and dst in b:
+                if peer.policy is None:
+                    peer.policy = LinkPolicy(src, dst, blocked=True)
+                else:
+                    peer.policy.blocked = True
+                count += 1
+        return count
+
+    def heal(self, group_a: Optional[Iterable[str]] = None,
+             group_b: Optional[Iterable[str]] = None) -> int:
+        """Unblock partitioned links: every link by default, or — given
+        ``group_a`` (and optionally ``group_b``) — only links crossing
+        that cut, both directions. Healing only flips ``blocked`` flags;
+        policy objects are NEVER replaced, so loss/delay knobs and RNG
+        streams survive — a direction that was never blocked (one-way
+        partition) is a no-op flip and its seeded schedule continues
+        undisturbed. Returns directed links unblocked."""
+        if group_a is None:
+            sel = None
+        else:
+            a: Set[str] = set(group_a)
+            b: Set[str] = (set(group_b) if group_b is not None
+                           else set(self.switches) - a)
+            sel = (a, b)
+        count = 0
+        for (src, dst), peer in self.links.items():
+            if sel is not None:
+                a, b = sel
+                if not ((src in a and dst in b)
+                        or (src in b and dst in a)):
+                    continue
+            if peer.policy is not None and peer.policy.blocked:
                 peer.policy.blocked = False
+                count += 1
+        return count
 
     def chaos_stats(self) -> collections.Counter:
         """Aggregate per-link policy counters (dropped/duplicated/...)."""

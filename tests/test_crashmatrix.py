@@ -38,6 +38,12 @@ def test_plan_determinism_and_shape():
     assert {k["boundary"] for k in p1["kills"]} == set(cm.ALL_BOUNDARIES)
     # the joiner boundary runs last; everything else targets the victim
     assert p1["kills"][-1]["target"] == "joiner"
+    # the quorum-loss window sits between the victim code-site kills and
+    # the joiner (statesync wants an already-healed, committing net)
+    pre_joiner = [k["boundary"] for k in p1["kills"][:-1]]
+    assert pre_joiner[-len(cm.QUORUM_BOUNDARIES):] == \
+        list(cm.QUORUM_BOUNDARIES)
+    assert all(k["target"] == cm.VICTIM for k in p1["kills"][:-1])
 
 
 def test_fingerprint_strips_wall_clock():
@@ -65,6 +71,22 @@ def test_single_boundary_live():
     assert k["killed"] and k["recovered"]
     assert not k["double_sign_observed"] and k["evidence"] == 0
     assert rep["mempool_wal_idempotent"] is True
+
+
+def test_quorum_loss_boundary_live():
+    """The net.during_quorum_loss window boundary live in tier-1: halt the
+    fleet by isolating >1/3 power, kill the majority-side victim at its
+    next WAL fsync INSIDE the halted window, heal, and prove the restart
+    replays a halt-spanning WAL with no double-sign."""
+    cm = _cm()
+    rep = cm.run_matrix(seed=1, boundaries=["net.during_quorum_loss"])
+    assert rep["boundaries_killed"] == ["net.during_quorum_loss"]
+    k = rep["kills"][0]
+    assert k["halted"] and k["halt_reason"] == "quorum_lost"
+    assert k["killed"] and k["kill_site"] == cm.QUORUM_KILL_SITE
+    assert k["recovered"]
+    assert not k["double_sign_observed"] and k["evidence"] == 0
+    assert k["recovery_records_replayed"] > 0
 
 
 @pytest.mark.slow

@@ -207,8 +207,11 @@ class TestManifestKeys:
         assert env2["TMTPU_RESTART_REASON"] == "crash"
 
     def test_fail_points_cover_crashmatrix_catalog(self):
-        """Every boundary the crash matrix enumerates is manifest-armable
-        (the subprocess variant of the same matrix)."""
+        """Every code-site boundary the crash matrix enumerates is
+        manifest-armable (the subprocess variant of the same matrix).
+        Window boundaries (net.during_quorum_loss) are rig-orchestrated
+        timing windows, not fail points — but the site each one arms
+        INSIDE its window must itself be armable."""
         import os
         import sys
 
@@ -221,4 +224,8 @@ class TestManifestKeys:
             import crashmatrix
         finally:
             sys.path.pop(0)
-        assert set(crashmatrix.ALL_BOUNDARIES) <= KNOWN_FAIL_POINTS
+        code_sites = (set(crashmatrix.ALL_BOUNDARIES)
+                      - set(crashmatrix.QUORUM_BOUNDARIES))
+        assert code_sites <= KNOWN_FAIL_POINTS
+        assert crashmatrix.QUORUM_KILL_SITE in KNOWN_FAIL_POINTS
+        assert not set(crashmatrix.QUORUM_BOUNDARIES) & KNOWN_FAIL_POINTS

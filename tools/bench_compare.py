@@ -45,9 +45,11 @@ DEFAULT_THRESHOLD = 0.30
 
 #: units gated as higher-is-better throughput; "headers/s" is the
 #: light-client serving plane's fleet-throughput unit (bench.py config
-#: lightserve, tools/lightserve_bench.py)
+#: lightserve, tools/lightserve_bench.py); "commits/min" is the
+#: degraded-network plane's WAN-profile throughput (bench.py config wan,
+#: tools/quorum_loss.py)
 HIGHER_BETTER_UNITS = {"sigs/s", "blocks/s", "blocks/min", "txs/s",
-                       "commits/s", "headers/s"}
+                       "commits/s", "commits/min", "headers/s"}
 #: units gated as lower-is-better latency; "breaches" is the soak
 #: plane's SLO-miss count (tools/soak.py) — more breaches is strictly
 #: worse, same gating shape as a latency
@@ -660,6 +662,47 @@ def self_test() -> int:
                      "lightserve_clients_headers_per_sec=0.9",
                      "--threshold", "lightserve_p99_s=9",
                      ls_base, ls_bad]) == 0
+        # the degraded-network rows (bench.py config wan): WAN-profile
+        # throughput ("commits/min") gates higher-better, quorum-loss
+        # recovery ("s") lower-better — both directions trip, both read
+        # improved when they move the right way, and the crashed-config
+        # convention (unit "error") trips rather than un-gates
+        assert gate_direction("inproc_wan4_commits_per_min",
+                              "commits/min") == "up"
+        assert gate_direction("inproc_quorumloss_recover_s", "s") == "down"
+        wn_base = os.path.join(d, "wan_base.json")
+        _write(wn_base, {"inproc_wan4_commits_per_min":
+                         (28.0, "commits/min"),
+                         "inproc_quorumloss_recover_s": (2.0, "s")})
+        wn_bad = os.path.join(d, "wan_bad.json")
+        _write(wn_bad, {"inproc_wan4_commits_per_min":
+                        (12.0, "commits/min"),
+                        "inproc_quorumloss_recover_s": (9.0, "s")})
+        assert main([wn_base, wn_bad]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(wn_base), load_bench(wn_bad), {})}
+        assert rows["inproc_wan4_commits_per_min"]["status"] == "regressed"
+        assert rows["inproc_quorumloss_recover_s"]["status"] == "regressed"
+        wn_good = os.path.join(d, "wan_good.json")
+        _write(wn_good, {"inproc_wan4_commits_per_min":
+                         (45.0, "commits/min"),
+                         "inproc_quorumloss_recover_s": (1.0, "s")})
+        assert main([wn_base, wn_good]) == 0
+        rows = {r["metric"]: r for r in compare(
+            load_bench(wn_base), load_bench(wn_good), {})}
+        assert rows["inproc_wan4_commits_per_min"]["status"] == "improved"
+        assert rows["inproc_quorumloss_recover_s"]["status"] == "improved"
+        wn_err = os.path.join(d, "wan_err.json")
+        _write(wn_err, {"inproc_wan4_commits_per_min": (0.0, "error"),
+                        "inproc_quorumloss_recover_s": (2.0, "s")})
+        assert main([wn_base, wn_err]) == 1
+        rows = {r["metric"]: r for r in compare(
+            load_bench(wn_base), load_bench(wn_err), {})}
+        assert rows["inproc_wan4_commits_per_min"]["status"] == "errored"
+        # ...and loosened per-metric thresholds un-trip the pair
+        assert main(["--threshold", "inproc_wan4_commits_per_min=0.9",
+                     "--threshold", "inproc_quorumloss_recover_s=9",
+                     wn_base, wn_bad]) == 0
         # cross-run history (--history): the JSONL trend file soak.py
         # appends to — the newest entry gates against the one before it,
         # a drifting trend exits 1, an improving one exits 0, and a

@@ -66,6 +66,22 @@ Churn cells — membership change as the fault (tools/churn.py rig):
   sparse net survives capped bit flips on in-flight payloads (receivers
   drop corrupting links, the redial loop re-heals), hashes identical
 
+Degraded-network cells — the hard regimes of partial synchrony
+(tools/quorum_loss.py + p2p/inproc.py link profiles):
+
+* net.quorum_loss — a seeded >1/3 isolation window over a live
+  4-validator fleet: height halts, zero conflicting commits, zero
+  equivocations, the watchdog reports halt_reason="quorum_lost" from
+  the blocking stage's vote bitmap, heal recovers to hash-identical
+  commits within the bound; run twice to pin the same-seed outcome
+  fingerprint
+* net.asym        — the seeded ``asym`` profile (one lossy direction per
+  pair, the reverse clean): the fleet keeps committing through the
+  asymmetry and reconverges hash-identical once cleared
+* net.gray        — ``gray`` links (60% loss, traffic still leaks) on
+  every link touching one node: quorum keeps committing, the gray node
+  is never declared dead and catches up hash-identical after the clear
+
 Execution cells — the parallel-execution plane (state/parallel.py):
 
 * exec.conflict_storm — every tx of every block writes the SAME key while
@@ -134,6 +150,11 @@ SITES = {
     "churn.rotate": True,
     "churn.partition32": True,
     "churn.corrupt32": True,
+    # degraded-network cells (quorum loss + link profiles;
+    # tools/quorum_loss.py + p2p/inproc.py LINK_PROFILES)
+    "net.quorum_loss": True,
+    "net.asym": True,
+    "net.gray": True,
     # execution cells (the parallel-execution plane; state/parallel.py)
     "exec.conflict_storm": False,
     # aggregate-signature cells (the BLS commit plane; crypto/bls12381)
@@ -1326,7 +1347,9 @@ def cell_soak_gameday(seed: int) -> None:
     assert soak.schedule_fingerprint(plan_a) == \
         soak.schedule_fingerprint(plan_b)
     planes = [ev["plane"] for ev in plan_a["events"]]
-    assert planes == ["churn", "corrupt"], planes  # 5 nodes: one spare full
+    # 5 nodes: one spare full (churn) + the always-on corrupt plane +
+    # the quorum-loss window a full 4-validator quorum always gets
+    assert planes == ["churn", "corrupt", "quorum_loss"], planes
 
     out = os.path.join(tempfile.mkdtemp(prefix="chaos_soak_"),
                        "soak_report.json")
@@ -1341,6 +1364,127 @@ def cell_soak_gameday(seed: int) -> None:
         att = b.get("attribution")
         assert att and att.get("plane"), f"silent breach: {b}"
     assert os.path.exists(out), "report never written"
+
+
+def _quorum_loss_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import quorum_loss
+
+    return quorum_loss
+
+
+def cell_net_quorum_loss(seed: int) -> None:
+    """The partially-synchronous contract under >1/3 isolation: a seeded
+    quorum-loss window over a live 4-validator fleet halts height advance
+    with zero conflicting commits and zero equivocations, the survivor's
+    watchdog classifies the halt ``quorum_lost`` from the blocking
+    stage's vote bitmap, and post-heal the fleet recovers to
+    hash-identical commits — run TWICE to pin the same-seed outcome
+    fingerprint (all asserted inside run_quorum_loss)."""
+    ql = _quorum_loss_mod()
+
+    assert ql.plan_quorum_loss(seed, 1) == ql.plan_quorum_loss(seed, 1)
+    vd = ql.verify_determinism(seed=seed, windows=1)
+    assert vd["ok"], f"same-seed outcomes diverged: {vd}"
+    assert all(s < ql.RECOVER_BOUND_S for s in vd["recover_s"]), vd
+
+
+def cell_net_asym(seed: int) -> None:
+    """Asymmetric degradation: the seeded ``asym`` profile makes one
+    direction of every pair lossy while the reverse stays clean — the
+    regime TCP-ish failure detectors misread. The 5-node fleet must keep
+    committing through it and reconverge hash-identical once cleared."""
+    import asyncio
+
+    from tendermint_tpu.p2p.inproc import plan_link_profiles
+
+    churn = _churn_mod()
+
+    ids = [f"n{i}" for i in range(5)]
+    plan = plan_link_profiles(ids, "asym", seed=seed)
+    assert plan == plan_link_profiles(ids, "asym", seed=seed)
+    # one degraded direction per pair, never both
+    for (src, dst) in plan:
+        assert (dst, src) not in plan, f"both directions degraded: {src},{dst}"
+
+    async def run():
+        net, nodes, _pvs, _genesis = await churn.build_fleet(5, seed=seed)
+        try:
+            for nd in nodes.values():
+                nd.cs.config.gossip_stall_refresh_s = 1.0
+            applied = net.apply_profile("asym", seed=seed)
+            assert applied == len(net.links) // 2, applied
+            await churn._wait_heights(list(nodes.values()), 2, timeout=120)
+            h0 = max(nd.height for nd in nodes.values())
+            await churn._wait_heights(list(nodes.values()), h0 + 3,
+                                      timeout=300)
+            net.clear_policies()
+            h1 = max(nd.height for nd in nodes.values())
+            await churn._wait_heights(list(nodes.values()), h1 + 1,
+                                      timeout=120)
+            common = min(nd.height for nd in nodes.values()) - 1
+            hashes = {nd.block_store.load_block_meta(common).header.app_hash
+                      for nd in nodes.values()}
+            assert len(hashes) == 1, "hashes diverged under asym links"
+        finally:
+            for nd in nodes.values():
+                try:
+                    await nd.stop()
+                except Exception:
+                    pass
+
+    asyncio.run(run())
+
+
+def cell_net_gray(seed: int) -> None:
+    """Gray failure: every link touching one full node runs the ``gray``
+    profile (60% loss — traffic leaks, so nothing declares the node
+    dead). The quorum must keep committing, the gray node must stay a
+    peer (never treated as departed) and keep making progress through
+    the leak, and once the links clear it must catch up hash-identical."""
+    import asyncio
+
+    churn = _churn_mod()
+
+    async def run():
+        net, nodes, _pvs, _genesis = await churn.build_fleet(5, seed=seed)
+        gray = "full0"
+        try:
+            for nd in nodes.values():
+                nd.cs.config.gossip_stall_refresh_s = 1.0
+            from tendermint_tpu.p2p.inproc import plan_link_profiles
+
+            plan = plan_link_profiles(sorted(nodes), "gray", seed=seed)
+            plan = {lk: kw for lk, kw in plan.items() if gray in lk}
+            applied = net.apply_link_plan(plan, seed=seed)
+            assert applied == 8, applied  # 4 peers x 2 directions
+            await churn._wait_heights(list(nodes.values()), 2, timeout=120)
+            majority = [nd for n, nd in nodes.items() if n != gray]
+            h0 = max(nd.height for nd in majority)
+            await churn._wait_heights(majority, h0 + 3, timeout=300)
+            # gray is a leak, not a blackhole: the node is still a peer
+            # of every survivor and still advancing through the loss
+            assert gray not in net.departed
+            for nd in majority:
+                assert gray in nd.switch.peers, \
+                    f"{nd.name} dropped the gray node"
+            assert nodes[gray].height > 0
+            net.clear_policies()
+            h1 = max(nd.height for nd in majority)
+            await churn._wait_heights(list(nodes.values()), h1 + 1,
+                                      timeout=180)
+            common = min(nd.height for nd in nodes.values()) - 1
+            hashes = {nd.block_store.load_block_meta(common).header.app_hash
+                      for nd in nodes.values()}
+            assert len(hashes) == 1, "hashes diverged across the gray link"
+        finally:
+            for nd in nodes.values():
+                try:
+                    await nd.stop()
+                except Exception:
+                    pass
+
+    asyncio.run(run())
 
 
 CELLS = {
@@ -1362,6 +1506,9 @@ CELLS = {
     "churn.rotate": cell_churn_rotate,
     "churn.partition32": cell_churn_partition32,
     "churn.corrupt32": cell_churn_corrupt32,
+    "net.quorum_loss": cell_net_quorum_loss,
+    "net.asym": cell_net_asym,
+    "net.gray": cell_net_gray,
     "exec.conflict_storm": cell_exec_conflict_storm,
     "aggsig.degrade": cell_aggsig_degrade,
     "crash.torn_wal": cell_crash_torn_wal,
@@ -1439,6 +1586,30 @@ def self_test() -> None:
     # churn plumbing: the plan the churn cells execute is deterministic
     churn = _churn_mod()
     assert churn.plan_churn(3, 2, 8) == churn.plan_churn(3, 2, 8)
+    # degraded-net plumbing, 2 seeds each: the quorum-loss plan and the
+    # link-profile plans the net.* cells execute are seed-deterministic
+    # (the live fleets themselves run via the matrix — they are the slow
+    # cells) and the planner invariants hold
+    ql = _quorum_loss_mod()
+    from tendermint_tpu.p2p.inproc import LINK_PROFILES, plan_link_profiles
+
+    ids = [f"n{i}" for i in range(5)]
+    for seed in (1, 2):
+        plan = ql.plan_quorum_loss(seed, windows=2)
+        assert plan == ql.plan_quorum_loss(seed, windows=2)
+        assert ql.plan_fingerprint(plan) == ql.plan_fingerprint(
+            ql.plan_quorum_loss(seed, windows=2))
+        for ev in plan["events"]:
+            assert ev["isolated_power"] * 3 > ev["total_power"], ev
+            assert 0 < len(ev["isolate"]) < plan["n_validators"], ev
+        for profile in LINK_PROFILES:
+            lp = plan_link_profiles(ids, profile, seed=seed)
+            assert lp == plan_link_profiles(ids, profile, seed=seed)
+            assert all(kw["profile"] == profile for kw in lp.values())
+        asym = plan_link_profiles(ids, "asym", seed=seed)
+        assert all((dst, src) not in asym for (src, dst) in asym)
+    assert ql.plan_quorum_loss(1, windows=2) != ql.plan_quorum_loss(
+        2, windows=2)
     # the crash cells are jax-free and fast: run them in-process too
     cell_crash_torn_wal(seed=1)
     faults.reset()

@@ -24,7 +24,12 @@ manufactures that moment at every enumerated boundary, deterministically:
   reload — rejoin the live net, catch back up via consensus catchup
   gossip;
 * the ``statesync.mid_chunk_apply`` boundary kills a fresh statesync
-  JOINER mid-restore instead; the retry restores from scratch.
+  JOINER mid-restore instead; the retry restores from scratch;
+* the ``net.during_quorum_loss`` boundary is a timing WINDOW, not a code
+  site: >1/3 of voting power is isolated until consensus halts
+  fleet-wide (watchdog ``quorum_lost``), and the victim is then killed
+  at its next WAL fsync INSIDE the halted window — proving WAL repair +
+  handshake replay across a halt-spanning WAL after the heal.
 
 Invariants per kill: the boundary actually fired; the victim recovers to
 a height >= the net's tip at restart; app hashes agree with survivors at
@@ -82,7 +87,16 @@ VICTIM_BOUNDARIES = (
 )
 #: boundaries killed on a fresh statesync JOINER mid-restore
 JOINER_BOUNDARIES = ("statesync.mid_chunk_apply",)
-ALL_BOUNDARIES = VICTIM_BOUNDARIES + JOINER_BOUNDARIES
+#: the degraded-network boundary: NOT a code fail point but a timing
+#: window — >1/3 of voting power is isolated until consensus halts
+#: fleet-wide (watchdog classifies quorum_lost), and only THEN is the
+#: victim killed, at its next WAL fsync (QUORUM_KILL_SITE; gossip
+#: stall-refresh re-sends keep peer records flowing through the wedged
+#: victim's WAL, so the armed site fires inside the halted window).
+#: Proves WAL repair + handshake replay across a halt-spanning WAL.
+QUORUM_BOUNDARIES = ("net.during_quorum_loss",)
+QUORUM_KILL_SITE = "wal.before_fsync"
+ALL_BOUNDARIES = VICTIM_BOUNDARIES + QUORUM_BOUNDARIES + JOINER_BOUNDARIES
 
 VICTIM = "crash"        # the persistent victim's node name
 N_SURVIVORS = 3         # val0..val2, in-memory
@@ -115,9 +129,14 @@ def plan_crashes(seed: int, boundaries=None) -> dict:
                          f"known: {list(ALL_BOUNDARIES)}")
     rng = random.Random(zlib.crc32(f"crash|{seed}".encode()))
     victim_kills = [b for b in boundaries if b in VICTIM_BOUNDARIES]
+    quorum_kills = [b for b in boundaries if b in QUORUM_BOUNDARIES]
     joiner_kills = [b for b in boundaries if b in JOINER_BOUNDARIES]
     rng.shuffle(victim_kills)
+    # the quorum-loss window halts the whole fleet for seconds — run it
+    # after the plain victim kills, before the joiner (whose statesync
+    # catchup wants an already-healed, committing net)
     kills = ([{"boundary": b, "target": VICTIM} for b in victim_kills]
+             + [{"boundary": b, "target": VICTIM} for b in quorum_kills]
              + [{"boundary": b, "target": "joiner"} for b in joiner_kills])
     return {"seed": seed, "kills": kills}
 
@@ -470,6 +489,11 @@ async def _run_async(seed: int, boundaries, home_root: str) -> dict:
                 kills.append(await _joiner_kill(net, nodes, genesis, seed,
                                                 boundary, churn, rig))
                 continue
+            if boundary in QUORUM_BOUNDARIES:
+                kills.append(await _quorum_loss_kill(
+                    net, nodes, genesis, survivor_names, victim_home,
+                    churn, rig))
+                continue
 
             victim = nodes[VICTIM]
             sup = RestartSupervisor(
@@ -666,6 +690,138 @@ async def _joiner_kill(net, nodes, genesis, seed, boundary, churn, rig):
             "backoff_s": backoff, "join_caughtup_s": caught}
 
 
+async def _quorum_loss_kill(net, nodes, genesis, survivor_names,
+                            victim_home, churn, rig):
+    """The net.during_quorum_loss boundary: WAL + handshake replay across
+    a quorum-loss halt. Two survivor validators (>1/3 of voting power)
+    are isolated until consensus halts fleet-wide and a survivor's
+    watchdog classifies the episode ``quorum_lost``; the victim — wedged
+    in the MAJORITY partition — is then killed at its next WAL fsync
+    (gossip stall-refresh re-sends keep peer records flowing through its
+    WAL, so the armed site fires while the window is still halted). The
+    partition heals and the victim rebuilds from its home dir: WAL
+    repair-on-open + handshake replay spanning the halted window, rejoin,
+    and the full fleet commits past the halt height — never
+    double-signing."""
+    import asyncio
+
+    from tendermint_tpu.consensus.watchdog import ConsensusWatchdog
+    from tendermint_tpu.libs.supervisor import RestartPolicy, RestartSupervisor
+
+    fail = rig["fail"]
+    CrashRigNode = rig["CrashRigNode"]
+    victim = nodes[VICTIM]
+    isolate = ["val1", "val2"]  # 20/40 power: >1/3, victim stays majority
+    # the recovery clock: bitmap refresh -> vote re-send (see
+    # tools/quorum_loss.py) — also what keeps peer records flowing
+    # through the wedged victim's WAL so the armed kill site fires
+    for nd in nodes.values():
+        nd.cs.config.gossip_stall_refresh_s = 1.0
+    observer = nodes["val0"]
+    wd = ConsensusWatchdog(observer.cs, stall_timeout_s=1.2,
+                           check_interval_s=0.3,
+                           height_fn=lambda: observer.height)
+    await wd.start()
+    sup = RestartSupervisor(
+        RestartPolicy(policy="on-failure", max_restarts=3, backoff_s=0.2,
+                      backoff_max_s=2.0, healthy_uptime_s=5.0), name=VICTIM,
+        time_fn=time.monotonic)
+    sup.on_launch()
+    lss_before = victim.pv.last_sign_state.height
+    t_kill0 = time.monotonic()
+    try:
+        net.partition(isolate)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not wd.stalls:
+            await asyncio.sleep(0.1)
+        assert wd.stalls, "fleet never halted under >1/3 isolation"
+        assert wd.last_halt_reason == "quorum_lost", \
+            f"halt misclassified: {wd.last_halt_reason!r}"
+        h_halt = max(nd.height for nd in nodes.values())
+
+        fail.arm_raise(QUORUM_KILL_SITE, scope_token=VICTIM)
+        # the armed site needs WAL traffic on the wedged victim. A PREVOTE
+        # wedge (no polka) gets it from gossip stall-refresh re-sends; a
+        # PRECOMMIT wedge keeps every link chatty with maj23 queries —
+        # never silent, so nothing is re-sent and nothing is WAL'd.
+        # Re-deliver one duplicate vote into the victim's queue (exactly
+        # what a stall-refresh re-send is): receive_routine WALs every
+        # peer record before applying it, and the group commit's deadline
+        # fsync fires the kill regardless of which step the wedge hit.
+        from tendermint_tpu.consensus.state import VoteMessage
+        deadline = time.monotonic() + 60
+        while (not victim.killed_evt.is_set()
+               and time.monotonic() < deadline):
+            rs = victim.cs.rs
+            vs = rs.votes.prevotes(rs.round) if rs.votes else None
+            votes = vs.list_votes() if vs is not None else []
+            if votes:
+                await victim.cs.add_peer_msg(VoteMessage(votes[0]), "val0")
+            await asyncio.sleep(0.1)
+        assert victim.killed_evt.is_set(), \
+            f"{QUORUM_KILL_SITE!r} never fired on {VICTIM} during the " \
+            f"halted window"
+        assert victim.killed_at == QUORUM_KILL_SITE
+        # the kill landed INSIDE the window: no commit since halt detection
+        h_at_kill = max(nd.height for nd in nodes.values())
+        assert h_at_kill == h_halt, \
+            f"height advanced during the halt: {h_halt} -> {h_at_kill}"
+        victim.freeze()
+        await _bounded(net.remove_node(VICTIM), 30, "remove_node(victim)")
+        await _bounded(victim.stop(), 30, "dead victim stop", fatal=False)
+        del nodes[VICTIM]
+
+        backoff = sup.on_exit(1)
+        assert backoff is not None and not sup.gave_up
+        await asyncio.sleep(backoff)
+
+        # heal and restart the victim immediately: the property under
+        # test is the restart replaying a halt-spanning WAL and
+        # rejoining, not 3-of-4 progress (the plain victim boundaries
+        # prove survivors commit while one validator is down) — and a
+        # full 40/40 post-heal fleet recovers exactly like the proven
+        # tools/quorum_loss.py window, where 30/40 with a dead proposer
+        # in the rotation can wedge on rare post-heal vote states
+        net.heal(group_a=isolate)
+        restarted = CrashRigNode(VICTIM, genesis, home=victim_home)
+        nodes[VICTIM] = restarted
+        sup.on_launch()
+        await _bounded(restarted.start(), 60, "restarted victim start")
+        await _bounded(net.add_node(restarted.switch,
+                                    connect_to=survivor_names),
+                       30, "add_node(restarted victim)")
+        await churn._wait_heights(list(nodes.values()), h_halt + 1,
+                                  timeout=120)
+    finally:
+        await wd.stop()
+    kill_to_caughtup = time.monotonic() - t_kill0
+
+    common = min(nd.height for nd in nodes.values()) - 1
+    hashes = {n: nd.block_store.load_block_meta(common).header.app_hash
+              for n, nd in nodes.items()}
+    assert len(set(hashes.values())) == 1, \
+        f"app hashes diverged after the quorum-loss kill: {hashes}"
+    lss_after = nodes[VICTIM].pv.last_sign_state.height
+    assert lss_after >= lss_before, \
+        f"sign state regressed across the halt: {lss_before} -> {lss_after}"
+    double_sign = _evidence_observed(nodes.values(), common)
+    assert not double_sign, \
+        f"double-sign evidence after the quorum-loss kill: {double_sign}"
+    return {
+        "boundary": QUORUM_BOUNDARIES[0], "target": VICTIM,
+        "kill_site": QUORUM_KILL_SITE, "killed": True, "halted": True,
+        "halt_reason": wd.last_halt_reason, "recovered": True,
+        "restarts": sup.restarts, "evidence": 0,
+        "double_sign_observed": False,
+        "wal_repaired": bool(nodes[VICTIM].wal_repairs),
+        "wal_repaired_bytes": nodes[VICTIM].wal_repaired_bytes,
+        "recovery_records_replayed":
+            nodes[VICTIM].recovery_records_replayed,
+        "kill_to_caughtup_s": round(kill_to_caughtup, 3),
+        "backoff_s": backoff,
+    }
+
+
 def _evidence_observed(nodes, up_to_height: int):
     """Any pending or committed DuplicateVoteEvidence anywhere — the
     on-the-wire observable of a double-sign."""
@@ -728,10 +884,16 @@ def self_test() -> int:
     from tendermint_tpu.libs.fail import KNOWN_FAIL_POINTS
     from tendermint_tpu.libs.supervisor import RestartPolicy
 
-    # the boundary catalog is a subset of the production fail points — a
-    # drifting name would make that cell pass vacuously
-    assert set(ALL_BOUNDARIES) <= set(KNOWN_FAIL_POINTS), \
-        sorted(set(ALL_BOUNDARIES) - set(KNOWN_FAIL_POINTS))
+    # the code-site boundary catalog is a subset of the production fail
+    # points — a drifting name would make that cell pass vacuously. The
+    # quorum-loss boundary is a timing WINDOW, not a code site; the site
+    # it arms inside the window must itself be real
+    assert (set(VICTIM_BOUNDARIES + JOINER_BOUNDARIES)
+            <= set(KNOWN_FAIL_POINTS)), \
+        sorted(set(VICTIM_BOUNDARIES + JOINER_BOUNDARIES)
+               - set(KNOWN_FAIL_POINTS))
+    assert QUORUM_KILL_SITE in KNOWN_FAIL_POINTS
+    assert not set(QUORUM_BOUNDARIES) & set(KNOWN_FAIL_POINTS)
     # plan determinism + shape
     p1 = plan_crashes(7)
     p2 = plan_crashes(7)
@@ -739,11 +901,14 @@ def self_test() -> int:
     assert plan_crashes(8) != p1, "seed does not vary the plan"
     assert len(p1["kills"]) == len(ALL_BOUNDARIES)
     assert {k["boundary"] for k in p1["kills"]} == set(ALL_BOUNDARIES)
-    # joiner boundaries always run last (donors need settled snapshots)
+    # joiner boundaries always run last (donors need settled snapshots),
+    # the quorum-loss window just before them (it halts the whole fleet)
     targets = [k["target"] for k in p1["kills"]]
     assert targets[-len(JOINER_BOUNDARIES):] == ["joiner"] * len(
         JOINER_BOUNDARIES)
     assert all(t == VICTIM for t in targets[:-len(JOINER_BOUNDARIES)])
+    pre_joiner = [k["boundary"] for k in p1["kills"]][:-len(JOINER_BOUNDARIES)]
+    assert pre_joiner[-len(QUORUM_BOUNDARIES):] == list(QUORUM_BOUNDARIES)
     # subset + unknown rejection
     sub = plan_crashes(1, ["wal.after_fsync"])
     assert [k["boundary"] for k in sub["kills"]] == ["wal.after_fsync"]

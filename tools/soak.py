@@ -3,7 +3,7 @@
 Each chaos cell arms ONE hostile condition for ~2 minutes and asserts
 invariants. This driver is the "game day" the ROADMAP calls for: an
 8-16 node in-proc fleet (churn.py's rig) under continuous open-loop
-SIGNED load at a measured fraction of admission capacity, with FOUR
+SIGNED load at a measured fraction of admission capacity, with FIVE
 planes armed concurrently from ONE seed:
 
 * churn    — a full node leaves, a fresh one statesync-joins (plan_churn);
@@ -11,7 +11,11 @@ planes armed concurrently from ONE seed:
              arm_raise, crashmatrix's kill machinery), then rebuilt and
              rejoined, kill-to-caught-up on the clock;
 * corrupt  — seeded bit flips on in-flight payloads (faults net.corrupt);
-* partition— a node black-holed from the fleet for a window, then healed.
+* partition— a node black-holed from the fleet for a window, then healed;
+* quorum_loss — >1/3 of validator power isolated for a bounded window
+             (tools/quorum_loss.py's planner, the deferred ROADMAP
+             cell): commits halt BY DESIGN, and any SLO breach inside
+             the window attributes to this plane, not to a mystery.
 
 The run is judged by a declarative SLOSpec (libs/slo.py): p99 commit
 latency, kill/join-to-caught-up, zero queue-full sheds under capacity,
@@ -82,15 +86,26 @@ def _slo_mod():
     return slo
 
 
+def _quorum_loss_mod():
+    if TOOLS_DIR not in sys.path:
+        sys.path.insert(0, TOOLS_DIR)
+    import quorum_loss
+    return quorum_loss
+
+
 # -- the deterministic plan (pure) -------------------------------------------
 
 def plan_gameday(seed: int, n_nodes: int, duration_s: float,
                  n_validators: int = 4) -> dict:
     """The multi-plane chaos schedule as a pure function of its inputs:
-    offset-timestamped armed windows, one per plane, victims drawn only
-    from full nodes (quorum is never touched). Small fleets degrade
-    gracefully: with no spare fulls only the corruption plane arms —
-    which is exactly the tier-1 smoke shape (2 nodes, one armed site)."""
+    offset-timestamped armed windows, one per plane. Victims of the
+    churn/crash/partition planes are drawn only from full nodes; the
+    quorum_loss plane is the ONE deliberate exception — it exists to
+    isolate >1/3 of validator power (quorum_loss.plan_quorum_loss picks
+    the seeded subset) and arms only when the fleet carries a full
+    4-validator quorum. Small fleets degrade gracefully: with no spare
+    fulls only the corruption plane arms — which is exactly the tier-1
+    smoke shape (2 nodes, one armed site)."""
     import random
     import zlib
 
@@ -141,6 +156,24 @@ def plan_gameday(seed: int, n_nodes: int, duration_s: float,
                        "kind": "blackhole", "node": iso,
                        "detail": f"partition {iso} from the fleet, "
                                  f"heal at window end"})
+    # quorum loss: isolate >1/3 of validator power for a bounded window
+    # (the seeded subset from quorum_loss.plan_quorum_loss) — kept clear
+    # of the corrupt window so a commit-latency breach inside the halt
+    # attributes to THIS plane, never smeared onto the bit flips
+    if min(n_validators, n_nodes) >= 4:
+        ql = _quorum_loss_mod()
+        qev = ql.plan_quorum_loss(
+            seed, 1, n_validators=min(n_validators, n_nodes))["events"][0]
+        t0, t1 = window(0.68, 0.8)
+        events.append({"t0": t0, "t1": t1, "plane": "quorum_loss",
+                       "kind": "net.quorum_loss", "node": None,
+                       "isolate": qev["isolate"],
+                       "isolated_power": qev["isolated_power"],
+                       "total_power": qev["total_power"],
+                       "detail": f"isolate {'+'.join(qev['isolate'])} "
+                                 f"({qev['isolated_power']}/"
+                                 f"{qev['total_power']} power, >1/3), "
+                                 f"heal at window end"})
     events.sort(key=lambda e: (e["t0"], e["plane"]))
     return {"seed": seed, "n_nodes": n_nodes,
             "duration_s": round(d, 3),
@@ -159,10 +192,11 @@ def synthetic_gameday(seed: int, n_nodes: int = 8, duration_s: float = 120.0,
                       spec_text=None) -> dict:
     """Seeded synthetic streams derived from the plan, pushed through the
     real SLO engine: commit latency spikes INSIDE the corruption window
-    (the injected regression — must attribute to its armed plane) and a
-    monotone RSS ramp spanning the whole run (the slow leak — must stay
-    loudly unattributed). The backbone of --verify-determinism and the
-    attribution self-test."""
+    on one node and inside the quorum-loss window on another (each
+    injected regression must attribute to ITS armed plane — the windows
+    are disjoint by construction) and a monotone RSS ramp spanning the
+    whole run (the slow leak — must stay loudly unattributed). The
+    backbone of --verify-determinism and the attribution self-test."""
     import random
     import zlib
 
@@ -173,13 +207,22 @@ def synthetic_gameday(seed: int, n_nodes: int = 8, duration_s: float = 120.0,
     spec = slo.SLOSpec.parse(spec_text) if spec_text else slo.SLOSpec.default()
     engine = slo.SLOEngine(spec)
     corrupt = [ev for ev in plan["events"] if ev["plane"] == "corrupt"]
-    node = churn.node_names(n_nodes)[0][0]
+    qloss = [ev for ev in plan["events"] if ev["plane"] == "quorum_loss"]
+    vals = churn.node_names(n_nodes)[0]
+    node, qnode = vals[0], vals[-1]
     t = 0.0
     while t < duration_s:
         lat = 0.3 + 0.2 * rng.random()
         if inject and any(ev["t0"] <= t <= ev["t1"] for ev in corrupt):
             lat = 30.0 + rng.random()
         engine.feed("commit_latency", t, lat, node=node)
+        if qloss:
+            # the halted quorum: commits stop inside the window, which a
+            # sliding p99 reads as a latency wall on the observing node
+            qlat = 0.3 + 0.2 * rng.random()
+            if inject and any(ev["t0"] <= t <= ev["t1"] for ev in qloss):
+                qlat = 30.0 + rng.random()
+            engine.feed("commit_latency", t, qlat, node=qnode)
         if leak:
             # 64 MB/s against an 8 MB/s bound: unmistakably a leak
             engine.feed("rss_bytes", t, 1e8 + t * 64e6, node=node)
@@ -355,6 +398,10 @@ async def _run_async(n_nodes: int, seed: int, duration_s: float,
         net.add_switch(nd.switch)
     for nd in nodes.values():
         await nd.start()
+        # a healed quorum-loss window recovers through the gossip
+        # self-heal (bitmap refresh -> vote re-send); the default 10s
+        # refresh would dominate every recovery inside a short soak
+        nd.cs.config.gossip_stall_refresh_s = 2.0
     await net.connect_topology(topology, degree=degree, seed=seed)
 
     scraper = FleetScraper(
@@ -518,10 +565,29 @@ async def _run_async(n_nodes: int, seed: int, duration_s: float,
         try:
             await asyncio.sleep(max(0.0, ev["t1"] - ev["t0"]))
         finally:
-            net.heal()
+            # heal exactly THIS cut: a global heal() would also erase a
+            # concurrently armed quorum-loss window
+            net.heal(group_a=[iso])
         armed_windows.append({"t0": t0, "t1": time.time(),
                               "plane": "partition", "node": iso,
                               "detail": ev["detail"]})
+
+    async def do_quorum_loss(ev):
+        isolate = list(ev["isolate"])
+        t0 = time.time()
+        h_cut = max((nd.height for nd in survivors()), default=0)
+        net.partition(isolate)
+        try:
+            await asyncio.sleep(max(0.0, ev["t1"] - ev["t0"]))
+        finally:
+            net.heal(group_a=isolate)
+        armed_windows.append({"t0": t0, "t1": time.time(),
+                              "plane": "quorum_loss", "node": None,
+                              "detail": ev["detail"],
+                              "height_at_cut": h_cut,
+                              "height_at_heal": max(
+                                  (nd.height for nd in survivors()),
+                                  default=0)})
 
     async def do_churn(ev):
         leaver, joiner = ev.get("node"), ev["join"]
@@ -606,7 +672,8 @@ async def _run_async(n_nodes: int, seed: int, duration_s: float,
                               "detail": ev["detail"]})
 
     EXEC = {"corrupt": do_corrupt, "partition": do_partition,
-            "churn": do_churn, "crash": do_crash}
+            "churn": do_churn, "crash": do_crash,
+            "quorum_loss": do_quorum_loss}
 
     async def run_event(ev):
         delay = ev["t0"] - (loop.time() - t_start)
@@ -860,29 +927,50 @@ def self_test() -> int:
          {"t0": 27.0, "t1": 41.0, "plane": "corrupt", "node": None}])
     assert att4["plane"] == "corrupt", att4
 
-    # plan: pure, seeded, quorum-safe
+    # plan: pure, seeded, quorum-safe (except the one plane built to
+    # take the quorum)
     p1 = plan_gameday(7, 8, 120)
     assert p1 == plan_gameday(7, 8, 120), "same-seed plans diverged"
     assert p1 != plan_gameday(8, 8, 120), "seed does not vary the plan"
     planes = {ev["plane"] for ev in p1["events"]}
-    assert planes == {"corrupt", "churn", "crash", "partition"}, planes
+    assert planes == {"corrupt", "churn", "crash", "partition",
+                      "quorum_loss"}, planes
     vals = {f"val{i}" for i in range(4)}
     for ev in p1["events"]:
         assert ev.get("node") not in vals, f"quorum touched: {ev}"
         assert 0 <= ev["t0"] <= ev["t1"] <= 120
-    # small fleets degrade to the corrupt-only smoke shape
+    # the quorum-loss window round-trips the quorum_loss planner: same
+    # seeded isolation subset, >1/3 of the power, never every validator
+    ql = _quorum_loss_mod()
+    qev = next(ev for ev in p1["events"] if ev["plane"] == "quorum_loss")
+    qplan = ql.plan_quorum_loss(7, 1, n_validators=4)["events"][0]
+    assert qev["isolate"] == qplan["isolate"], (qev, qplan)
+    assert qev["isolated_power"] == qplan["isolated_power"]
+    assert qev["isolated_power"] * 3 > qev["total_power"], qev
+    assert set(qev["isolate"]) < vals, qev
+    # ...and stays clear of the corrupt window (attribution clarity)
+    cev = next(ev for ev in p1["events"] if ev["plane"] == "corrupt")
+    assert qev["t0"] >= cev["t1"] or qev["t1"] <= cev["t0"], (qev, cev)
+    # small fleets degrade to the corrupt-only smoke shape; a full
+    # quorum (>= 4 validators) always gets its loss window
     assert [ev["plane"] for ev in plan_gameday(1, 2, 30)["events"]] \
         == ["corrupt"]
     assert {ev["plane"] for ev in plan_gameday(1, 5, 30)["events"]} \
-        == {"corrupt", "churn"}
+        == {"corrupt", "churn", "quorum_loss"}
 
-    # the pure half: injected regression attributes to its armed plane,
-    # the leak stays loudly unattributed, fingerprints replay
-    g = synthetic_gameday(3, 8, 120)
+    # the pure half: each injected regression attributes to ITS armed
+    # plane, the leak stays loudly unattributed, fingerprints replay.
+    # The latency objective runs a tighter sliding window here: the
+    # default 30s window smears a breach well past the short quorum-loss
+    # window, dropping the true cause below the attribution cover floor
+    g = synthetic_gameday(
+        3, 8, 120,
+        spec_text="commit_latency p99 <= 20.0 window=10\n"
+                  "rss_bytes slope <= 8388608\n")
     lat = [b for b in g["breaches"]
            if b["objective"] == "commit_latency_p99"]
-    assert lat and all(b["attribution"]["plane"] == "corrupt"
-                       for b in lat), lat
+    lat_planes = {b["attribution"]["plane"] for b in lat}
+    assert lat and lat_planes == {"corrupt", "quorum_loss"}, lat
     leaks = [b for b in g["breaches"] if b["objective"] == "rss_bytes_slope"]
     assert leaks and all(b["attribution"]["plane"] == "unattributed"
                          for b in leaks), leaks
